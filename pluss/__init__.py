@@ -1,0 +1,16 @@
+"""pluss-tpu: TPU-native PLUSS — static sampling of reuse-interval histograms
+and miss-ratio curves for parallel affine loop nests.
+
+A ground-up JAX/XLA re-design of ``NoyaFangzhou/PLUSS_Sampler_Optimization``
+(mounted read-only at /root/reference; see SURVEY.md).  The reference's
+generated per-workload state machines, hashmap last-access tables, and
+lock-guarded global histograms become declarative loop-nest specs, sort-based
+reuse extraction over whole access streams, and dense histograms merged with
+``psum`` over a device mesh.
+"""
+
+from pluss.config import SamplerConfig, DEFAULT
+from pluss.spec import Loop, LoopNestSpec, Ref
+from pluss.sched import ChunkSchedule
+
+__version__ = "0.1.0"

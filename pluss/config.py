@@ -1,0 +1,76 @@
+"""Model constants and run configuration.
+
+The reference hardcodes these as compile-time ``-D`` macros
+(``/root/reference/c_lib/test/Makefile:12-13``) and duplicated Rust ``const``s
+(``/root/reference/src/gemm_sampler.rs:27-30``, ``src/utils.rs:10-11``).  Here they
+live in one runtime-configurable dataclass; every named quirk constant of the
+reference's statistics pipeline is spelled out with its provenance so golden-output
+parity is auditable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerConfig:
+    """Schedule + machine-model parameters of one sampling run.
+
+    Mirrors the reference's compile-time configuration surface:
+
+    - ``thread_num``  — ``-DTHREAD_NUM=4``   (Makefile:12-13)
+    - ``chunk_size``  — ``-DCHUNK_SIZE=4``
+    - ``ds``          — ``-DDS=8``   element size in bytes
+    - ``cls``         — ``-DCLS=64`` cache-line size in bytes
+    - ``cache_kb``    — ``POLYBENCH_CACHE_SIZE_KB`` default 2560 (pluss.cpp:9-11)
+    """
+
+    thread_num: int = 4
+    chunk_size: int = 4
+    ds: int = 8
+    cls: int = 64
+    cache_kb: int = 2560
+
+    @property
+    def lines_per_element_div(self) -> int:
+        """Elements per cache line: ``CLS // DS`` (address -> line is addr*DS//CLS)."""
+        return self.cls // self.ds
+
+    @property
+    def aet_cache_entries(self) -> int:
+        """AET sweep bound: ``cache_kb * 1024 / sizeof(double)``
+        (pluss_utils.h:785: ``cs = 2560 * 1024 / sizeof(double)``)."""
+        return self.cache_kb * 1024 // 8
+
+
+# --- Statistics-model quirk constants (behavioral contract, SURVEY.md §5) ------
+
+#: NBD point-mass cutoff: thread-local reuse n >= NBD_CUTOFF_COEF*(T-1)/T is
+#: emitted as a point mass at T*n instead of a negative-binomial dilation
+#: (pluss_utils.h:993-997, src/utils.rs:216-221).  3000 for T=4.
+NBD_CUTOFF_COEF = 4000.0
+
+#: NBD tail truncation: pmf terms are accumulated until the running mass
+#: exceeds this value; the crossing term is included (pluss_utils.h:1001-1008).
+NBD_MASS_CUT = 0.9999
+
+#: MRC printer dedup epsilon: runs of miss ratios whose successive difference is
+#: below this are collapsed (pluss_utils.h:863, 899).
+MRC_DEDUP_EPS = 1e-5
+
+#: AET vestigial first-step epsilon (pluss_utils.h:798): with MRC_pred=-1 the
+#: branch `MRC_pred - P[prev_t] < 1e-4` is always true, so every c gets an entry.
+AET_PRED_EPS = 1e-4
+
+#: Number of dense histogram slots used by the XLA engine.  Slot 0 holds the
+#: cold-miss key (-1); slot 1+e holds the log2 bin with key 2**e.  48 exponent
+#: slots cover reuse intervals up to 2**47 (a 140-trillion-access stream).
+NBINS = 49
+
+#: Default capacity for the fixed-size unique-value extraction of "share"
+#: (cross-thread) reuse values, which the reference keeps raw (unbinned) until
+#: the racetrack post-pass (pluss_utils.h:928-937; SURVEY.md Q6).
+SHARE_CAP = 1024
+
+DEFAULT = SamplerConfig()

@@ -1,0 +1,161 @@
+"""CRI model: per-thread reuse intervals -> whole-system reuse intervals.
+
+Post-pass converting thread-local histograms into system-wide ones, preserving
+the reference's exact statistics (``/root/reference/src/utils.rs:213-349``,
+``c_lib/test/runtime/pluss_utils.h:986-1208``):
+
+1. **NBD dilation** — a thread-local reuse of length n is stretched by the other
+   threads' interleaved accesses; the number of foreign accesses k follows
+   NegativeBinomial(r=n, p=1/T).  Terms accumulate until mass > 0.9999 (the
+   crossing term included, pluss_utils.h:1001-1008); n >= 4000*(T-1)/T
+   short-circuits to a point mass at T*n (pluss_utils.h:993-997).
+2. **No-share distribute** — merge per-thread no-share histograms, pass cold
+   (key < 0) through, NBD-dilate the rest into the final log2-binned histogram
+   (pluss_utils.h:1010-1039).
+3. **Racetrack** — share reuses are additionally split across log2 bins with
+   ``prob[i] = (1-2^(i-1)/ri)^n - (1-2^i/ri)^n`` and the *last computed bin
+   overwritten* by the residual ``1-prob_sum`` (pluss_utils.h:1078-1093 — the
+   overwrite, not an add, is load-bearing for golden parity), emitting
+   ``new_ri = 2^(i-1)`` (pluss_utils.h:1094-1097).  Note bin i=1's emission key
+   is 2^0=1, and an ri<2 emits everything at key int(2^-1)=0.
+
+Histograms here are tiny (tens of entries), so this runs on the host in f64 —
+matching the C++ doubles is worth far more than device offload; the heavy
+per-access work already happened in :mod:`pluss.engine`.  The NBD pmf is
+vectorized over k with ``lgamma`` (SURVEY.md §7 hard part 3), same
+parameterization as GSL's ``gsl_ran_negative_binomial_pdf(k, p, n)``
+(pluss_utils.h:1002) and statrs' ``NegativeBinomial::pmf`` (utils.rs:226-228).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from pluss.config import NBD_CUTOFF_COEF, NBD_MASS_CUT
+
+try:  # scipy is present in this image but not guaranteed; gate it
+    from scipy.special import gammaln as _gammaln
+except Exception:  # pragma: no cover
+    _gammaln = np.vectorize(math.lgamma, otypes=[np.float64])
+
+Histogram = dict  # key: int reuse (or -1 cold); value: float count
+
+
+def histogram_update(hist: Histogram, reuse: int, cnt: float,
+                     in_log_format: bool = True) -> None:
+    """``_pluss_histogram_update`` (utils.rs:142-152): log2-bin positive keys."""
+    if reuse > 0 and in_log_format:
+        reuse = 1 << (int(reuse).bit_length() - 1)
+    hist[reuse] = hist.get(reuse, 0.0) + cnt
+
+
+def merge(hists: list[Histogram]) -> Histogram:
+    """Plain key-wise sum (the reference's per-thread merge loops,
+    pluss_utils.h:1013-1022)."""
+    out: Histogram = {}
+    for h in hists:
+        for k, v in h.items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def nbd_dilate(thread_cnt: int, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """``_pluss_cri_nbd`` (utils.rs:213-236): (system reuse values, pmf).
+
+    Returns keys ``n + k`` for k = 0..K where K is the first index at which the
+    cumulative pmf exceeds NBD_MASS_CUT (that term included), or the single
+    point mass ``T*n`` past the cutoff.
+    """
+    if n >= NBD_CUTOFF_COEF * (thread_cnt - 1) / thread_cnt:
+        return np.array([thread_cnt * n], np.int64), np.array([1.0])
+    p = 1.0 / thread_cnt
+    r = float(n)
+    # mean of NB(r, p) is r(1-p)/p = (T-1)n; 0.9999 mass sits within a few stds
+    block = max(64, int((thread_cnt - 1) * n * 2) + 64)
+    ks = np.arange(0, block, dtype=np.float64)
+    while True:
+        pmf = np.exp(
+            _gammaln(ks + r) - _gammaln(ks + 1.0) - _gammaln(r)
+            + r * math.log(p) + ks * math.log1p(-p)
+        )
+        cum = np.cumsum(pmf)
+        over = np.nonzero(cum > NBD_MASS_CUT)[0]
+        if over.size:
+            stop = int(over[0]) + 1  # include the crossing term
+            ks_i = np.arange(stop, dtype=np.int64)
+            return ks_i + n, pmf[:stop]
+        ks = np.arange(0, ks.size * 2, dtype=np.float64)  # pragma: no cover
+
+
+def noshare_distribute(noshare: list[Histogram], rihist: Histogram,
+                       thread_cnt: int) -> None:
+    """``_pluss_cri_noshare_distribute`` (utils.rs:307-344)."""
+    for k, v in merge(noshare).items():
+        if k < 0:
+            histogram_update(rihist, k, v)
+            continue
+        if thread_cnt > 1:
+            keys, pmf = nbd_dilate(thread_cnt, k)
+            for kk, vv in zip(keys, pmf):
+                histogram_update(rihist, int(kk), v * float(vv))
+        else:
+            histogram_update(rihist, k, v)
+
+
+def racetrack_bins(ri: int, n: float) -> list[tuple[int, float]]:
+    """Split one dilated share reuse ``ri`` across log2 bins; reference loop at
+    pluss_utils.h:1076-1097 including the residual overwrite of the last bin.
+
+    Returns (emission key ``int(2**(i-1))``, probability) pairs.
+    """
+    probs: dict[int, float] = {}
+    prob_sum = 0.0
+    i = 1
+    while True:
+        if 2.0 ** i > ri:
+            break
+        probs[i] = (1 - 2.0 ** (i - 1) / ri) ** n - (1 - 2.0 ** i / ri) ** n
+        prob_sum += probs[i]
+        i += 1
+        if prob_sum == 1.0:
+            break
+    if prob_sum != 1.0:
+        probs[i - 1] = 1.0 - prob_sum  # OVERWRITES the last computed bin
+    return [(int(2.0 ** (b - 1)), p) for b, p in probs.items()]
+
+
+def racetrack(share: list[Histogram], rihist: Histogram, thread_cnt: int) -> None:
+    """``_pluss_cri_racetrack`` (utils.rs:238-301).
+
+    ``share``: per-thread {share_ratio: {raw reuse: count}} as the engine and
+    reference both keep them (the ratio is the carried share count n).
+    """
+    merged: dict[int, Histogram] = {}
+    for h in share:
+        for n_key, hist in h.items():
+            m = merged.setdefault(n_key, {})
+            for r, c in hist.items():
+                m[r] = m.get(r, 0.0) + c
+    for n_key, hist in merged.items():
+        n = float(n_key)
+        for r, c in hist.items():
+            if thread_cnt <= 1:
+                histogram_update(rihist, r, c)
+                continue
+            keys, pmf = nbd_dilate(thread_cnt, r)
+            for ri, pv in zip(keys, pmf):
+                cnt = c * float(pv)
+                for key, bp in racetrack_bins(int(ri), n):
+                    histogram_update(rihist, key, bp * cnt)
+
+
+def distribute(noshare: list[Histogram], share: list[Histogram],
+               thread_cnt: int) -> Histogram:
+    """``pluss_cri_distribute`` (utils.rs:346-349): fresh result per call —
+    the per-run reset the reference's Rust build lacks (SURVEY.md Q1)."""
+    rihist: Histogram = {}
+    noshare_distribute(noshare, rihist, thread_cnt)
+    racetrack(share, rihist, thread_cnt)
+    return rihist

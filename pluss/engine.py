@@ -1,0 +1,214 @@
+"""The sampler engine: affine stream enumeration + sort-based reuse, in XLA.
+
+Replaces the reference's generated per-workload state machines
+(``/root/reference/src/gemm_sampler.rs:56-293``; C++ twin ``…omp.cpp:37-333``).
+Where the reference steps one access at a time through a six-state machine,
+here every occurrence of every static reference is materialized by broadcasted
+``iota`` arithmetic straight from the :class:`~pluss.spec.FlatRef` affine forms:
+
+- stream position  ``pos  = nest_base + rank*stride0 + sum(idx_l*stride_l) + offset``
+- element address  ``addr = base + sum(coef_l * iv_l)`` -> cache line ``addr*DS//CLS``
+
+The simulated-thread dimension is a pure ``vmap`` axis: per-thread state is
+disjoint by construction in the reference (SURVEY.md §2 "execution parallelism"),
+so threads need no interaction until the histogram merge, which is an integer
+add (and a ``psum`` across devices, see :mod:`pluss.parallel`).
+
+Results are *dense*: a [T, NBINS] no-share histogram (slot 0 = the cold key -1,
+slot 1+e = log2 key 2^e) and fixed-capacity raw (value, count) share pairs per
+thread, exactly the data the CRI post-pass (:mod:`pluss.cri`) consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
+from pluss.ops.reuse import LINE_SENTINEL, noshare_histogram, reuse_events, share_unique
+from pluss.sched import ChunkSchedule
+from pluss.spec import FlatRef, LoopNestSpec, flatten_nest, nest_iteration_size
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamPlan:
+    """Static (trace-time) description of one workload's per-thread stream."""
+
+    spec: LoopNestSpec
+    cfg: SamplerConfig
+    # per nest: (schedule, flat refs, padded length per thread)
+    nests: tuple[tuple[ChunkSchedule, tuple[FlatRef, ...], int], ...]
+    iters_per_thread: np.ndarray      # [n_nests, T] true parallel iterations
+    nest_base: np.ndarray             # [n_nests, T] clock offset of each nest
+    padded_len: int                   # per-thread padded stream length
+    total_count: int                  # true total accesses over all threads
+
+
+def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT) -> StreamPlan:
+    T = cfg.thread_num
+    nests = []
+    iters = np.zeros((len(spec.nests), T), np.int64)
+    for ni, nest in enumerate(spec.nests):
+        sched = ChunkSchedule(cfg.chunk_size, nest.trip, nest.start, nest.step, T)
+        refs = tuple(flatten_nest(nest))
+        body = nest_iteration_size(nest)
+        padded = sched.max_rounds() * cfg.chunk_size * body
+        nests.append((sched, refs, padded))
+        for t in range(T):
+            iters[ni, t] = len(sched.thread_iteration_indices(t))
+    body_sizes = np.array(
+        [nest_iteration_size(n) for n in spec.nests], np.int64
+    )
+    nest_base = np.zeros_like(iters)
+    nest_base[1:] = np.cumsum(iters[:-1] * body_sizes[:-1, None], axis=0)
+    padded_len = sum(p for _, _, p in nests)
+    total = int((iters * body_sizes[:, None]).sum())
+    return StreamPlan(
+        spec=spec,
+        cfg=cfg,
+        nests=tuple(nests),
+        iters_per_thread=iters,
+        nest_base=nest_base,
+        padded_len=padded_len,
+        total_count=total,
+    )
+
+
+def _ref_stream(fr: FlatRef, sched: ChunkSchedule, cfg: SamplerConfig,
+                tid, nest_base, line_base: int):
+    """(line, pos, span, valid) flat arrays for all occurrences of one ref."""
+    T, CS = cfg.thread_num, cfg.chunk_size
+    R = sched.max_rounds()
+    shape = (R, CS) + fr.trips[1:]
+    ndim = len(shape)
+
+    def iota(axis):
+        return jax.lax.broadcasted_iota(jnp.int32, shape, axis)
+
+    r, p = iota(0), iota(1)
+    g = (r * T + tid) * CS + p
+    valid = g < sched.trip
+    rank = r * CS + p
+
+    pos = nest_base + rank * fr.pos_strides[0] + fr.offset
+    addr = fr.ref.addr_base + fr.addr_coefs[0] * (sched.start + g * sched.step)
+    for l in range(1, len(fr.trips)):
+        idx = iota(l + 1)
+        pos = pos + idx * fr.pos_strides[l]
+        if fr.addr_coefs[l]:
+            addr = addr + fr.addr_coefs[l] * (fr.starts[l] + idx * fr.steps[l])
+    line = line_base + addr * cfg.ds // cfg.cls
+    span = jnp.full(shape, fr.ref.share_span or 0, jnp.int32)
+    return (
+        jnp.where(valid, line, LINE_SENTINEL).reshape(-1).astype(jnp.int32),
+        pos.reshape(-1).astype(jnp.int32),
+        span.reshape(-1),
+        valid.reshape(-1),
+    )
+
+
+def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
+    """Full per-thread pipeline: enumerate -> sort -> histogram.  vmapped on tid."""
+    cfg = pl.cfg
+    bases = pl.spec.line_bases(cfg)
+    lines, poss, spans, valids = [], [], [], []
+    nest_base = jnp.asarray(pl.nest_base, jnp.int32)
+    for ni, (sched, refs, _) in enumerate(pl.nests):
+        for fr in refs:
+            l, p, s, v = _ref_stream(
+                fr, sched, cfg, tid, nest_base[ni, tid],
+                bases[pl.spec.array_index(fr.ref.array)],
+            )
+            lines.append(l); poss.append(p); spans.append(s); valids.append(v)
+    line = jnp.concatenate(lines)
+    pos = jnp.concatenate(poss)
+    span = jnp.concatenate(spans)
+    valid = jnp.concatenate(valids)
+    ev = reuse_events(line, pos, span, valid)
+    hist = noshare_histogram(ev)
+    svals, scnts, snu = share_unique(ev, share_cap)
+    return hist, svals, scnts, snu
+
+
+@functools.lru_cache(maxsize=None)
+def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int):
+    """(plan, jitted fn) for a workload; cached so repeat runs reuse the XLA
+    executable (the reference's `speed` mode re-runs the same sampler 3x,
+    main.rs:23-35)."""
+    pl = plan(spec, cfg)
+
+    def f(tids):
+        return jax.vmap(lambda t: _thread_pipeline(t, pl, share_cap))(tids)
+
+    return pl, jax.jit(f)
+
+
+@dataclasses.dataclass
+class SamplerResult:
+    """Per-thread dense histograms + dict views matching the reference's state.
+
+    ``noshare[t]`` corresponds to ``_NoSharePRI[t]`` (keys -1 and powers of two,
+    utils.rs:14), ``share[t]`` to ``_SharePRI[t]`` (raw keys under the single
+    share-ratio group T-1, utils.rs:18), ``max_iteration_count`` to the printed
+    "max iteration traversed" (gemm_sampler.rs:305).
+    """
+
+    noshare_dense: np.ndarray   # [T, NBINS] int64
+    share_vals: np.ndarray      # [T, CAP] int32
+    share_cnts: np.ndarray      # [T, CAP] int64
+    share_ratio: int
+    max_iteration_count: int
+
+    @property
+    def thread_num(self) -> int:
+        return self.noshare_dense.shape[0]
+
+    def noshare_dict(self, tid: int) -> dict:
+        # the cold key is always present: the reference's end-of-run flush
+        # inserts -1 per (thread, array) even when the LAT table is empty
+        # (gemm_sampler.rs:48-53 with len 0), so idle threads report {-1: 0.0}
+        out = {-1: float(self.noshare_dense[tid][0])}
+        row = self.noshare_dense[tid]
+        for e in range(NBINS - 1):
+            if row[1 + e]:
+                out[1 << e] = float(row[1 + e])
+        return out
+
+    def share_dict(self, tid: int) -> dict:
+        h = {
+            int(v): float(c)
+            for v, c in zip(self.share_vals[tid], self.share_cnts[tid])
+            if c
+        }
+        return {self.share_ratio: h} if h else {}
+
+    def noshare_list(self) -> list[dict]:
+        return [self.noshare_dict(t) for t in range(self.thread_num)]
+
+    def share_list(self) -> list[dict]:
+        return [self.share_dict(t) for t in range(self.thread_num)]
+
+
+def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
+        share_cap: int = SHARE_CAP) -> SamplerResult:
+    """Run the sampler on the default backend (vmap over simulated threads)."""
+    pl, f = compiled(spec, cfg, share_cap)
+    tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
+    hist, svals, scnts, snu = f(tids)
+    snu = np.asarray(snu)
+    if (snu > share_cap).any():
+        raise ValueError(
+            f"share-value capacity exceeded: {int(snu.max())} uniques > cap "
+            f"{share_cap}; re-run with a larger share_cap"
+        )
+    return SamplerResult(
+        noshare_dense=np.asarray(hist, np.int64),
+        share_vals=np.asarray(svals),
+        share_cnts=np.asarray(scnts, np.int64),
+        share_ratio=cfg.thread_num - 1,
+        max_iteration_count=pl.total_count,
+    )

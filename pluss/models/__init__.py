@@ -1,0 +1,21 @@
+"""Workload specs (the reference's per-kernel generated samplers, declaratively).
+
+Each builder returns a :class:`pluss.spec.LoopNestSpec`.  ``gemm`` reproduces the
+reference's only shipped workload; the others cover the BASELINE.json configs
+(PolyBench 2mm/3mm/syrk, conv2d 3x3, stencil-3D).
+"""
+
+from pluss.models.gemm import gemm
+from pluss.models.polybench import mm2, mm3, syrk
+from pluss.models.stencils import conv2d, stencil3d
+
+REGISTRY = {
+    "gemm": gemm,
+    "2mm": mm2,
+    "3mm": mm3,
+    "syrk": syrk,
+    "conv2d": conv2d,
+    "stencil3d": stencil3d,
+}
+
+__all__ = ["gemm", "mm2", "mm3", "syrk", "conv2d", "stencil3d", "REGISTRY"]

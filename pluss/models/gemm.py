@@ -1,0 +1,55 @@
+"""PolyBench GEMM spec: ``C[i][j] = beta*C[i][j] + alpha*A[i][k]*B[k][j]``.
+
+Reproduces the reference's generated GEMM sampler
+(``/root/reference/src/gemm_sampler.rs:56-293``,
+``c_lib/test/sampler/gemm-t4-pluss-pro-model-ri-omp.cpp:37-333``) derived from the
+ppcg-parallelized source ``c_lib/test/gemm.ppcg_omp.c:90-96``:
+
+.. code-block:: c
+
+    #pragma pluss parallel          // outer c0 loop chunked over threads
+    for (c0 ...) for (c1 ...) {
+        C[c0][c1] *= beta;          // refs C0 (load), C1 (store)
+        for (c2 ...)
+            C[c0][c1] += alpha*A[c0][c2]*B[c2][c1];  // A0, B0, C2, C3
+    }
+
+Reference order per (c0,c1): C0, C1, then per c2: A0, B0, C2, C3 — exactly the
+state-machine transition chain C0→C1→(A0→B0→C2→C3)* (``gemm_sampler.rs:135-266``).
+
+Only B0 carries a cross-thread ("share") reuse test: B[c2][c1] is carried by the
+c1 loop, which sits *above* nothing parallel but spans whole c0 rows; the
+generated threshold is ``(trip+1)*trip + 1`` = 16513 for trip=128
+(``gemm_sampler.rs:196-199``, ``…omp.cpp:202-203``).
+
+Every address uses row-major stride equal to the problem size for all three
+arrays (``get_addr``, ``gemm_sampler.rs:34-38`` — the reference hardcodes 128;
+correct only because NI=NJ=NK, SURVEY.md Q8).  We keep stride = n.
+"""
+
+from __future__ import annotations
+
+from pluss.spec import Loop, LoopNestSpec, Ref, share_span_formula
+
+
+def gemm(n: int = 128) -> LoopNestSpec:
+    span = share_span_formula(n)
+    c0 = lambda name: Ref(name, "C", addr_terms=((0, n), (1, 1)))
+    inner = Loop(
+        trip=n,
+        body=(
+            Ref("A0", "A", addr_terms=((0, n), (2, 1))),
+            Ref("B0", "B", addr_terms=((2, n), (1, 1)), share_span=span),
+            c0("C2"),
+            c0("C3"),
+        ),
+    )
+    nest = Loop(
+        trip=n,
+        body=(Loop(trip=n, body=(c0("C0"), c0("C1"), inner)),),
+    )
+    return LoopNestSpec(
+        name=f"gemm{n}",
+        arrays=(("C", n * n), ("A", n * n), ("B", n * n)),
+        nests=(nest,),
+    )

@@ -1,0 +1,98 @@
+"""PolyBench 2mm / 3mm / syrk specs (BASELINE.json config 3).
+
+The reference ships only the generated GEMM sampler; these specs are authored in
+the same ppcg/pluss style it was generated from (``/root/reference/c_lib/test/
+gemm.ppcg_omp.c:72-98``): the outermost loop of every nest is the parallel dim,
+loads precede the store of the same statement, and the accumulation statement
+re-loads and re-stores its output element each k iteration (GEMM's C2/C3 pair,
+``…omp.cpp:214-300``).
+
+Share spans follow the generated formula ``(trip+1)*trip+1`` of the j loop
+(``…omp.cpp:202``) and are attached to exactly the refs whose row index does not
+involve the parallel iterator — those are the reuses that cross simulated
+threads, as B0 does in GEMM (``gemm_sampler.rs:196-201``).
+
+``syrk`` uses the rectangular (full-matrix) PolyBench 3.x form so all loops stay
+rectangular; PolyBench 4.2's triangular j<=i variant is out of scope for the
+affine engine and noted here for the record.
+"""
+
+from __future__ import annotations
+
+from pluss.spec import Loop, LoopNestSpec, Ref, share_span_formula
+
+
+def _matmul_nest(n: int, out: str, a: str, b: str, init_pair: bool) -> Loop:
+    """One ``out = (init) ; out += a*b`` nest in generated-sampler style.
+
+    ``init_pair``: True emits load+store (the ``*= beta`` pattern, GEMM C0/C1),
+    False emits a single store (the ``= 0`` pattern of 2mm/3mm's first nests).
+    """
+    span = share_span_formula(n)
+    o = lambda nm: Ref(nm, out, addr_terms=((0, n), (1, 1)))
+    head = (o(f"{out}0"), o(f"{out}1")) if init_pair else (o(f"{out}0"),)
+    inner = Loop(
+        trip=n,
+        body=(
+            Ref(f"{a}0", a, addr_terms=((0, n), (2, 1))),
+            Ref(f"{b}0", b, addr_terms=((2, n), (1, 1)), share_span=span),
+            o(f"{out}2"),
+            o(f"{out}3"),
+        ),
+    )
+    return Loop(trip=n, body=(Loop(trip=n, body=head + (inner,)),))
+
+
+def mm2(n: int = 128) -> LoopNestSpec:
+    """2mm: ``tmp = alpha*A*B`` then ``D = beta*D + tmp*C``."""
+    return LoopNestSpec(
+        name=f"2mm{n}",
+        arrays=(("tmp", n * n), ("A", n * n), ("B", n * n), ("C", n * n), ("D", n * n)),
+        nests=(
+            _matmul_nest(n, "tmp", "A", "B", init_pair=False),
+            _matmul_nest(n, "D", "tmp", "C", init_pair=True),
+        ),
+    )
+
+
+def mm3(n: int = 128) -> LoopNestSpec:
+    """3mm: ``E = A*B``, ``F = C*D``, ``G = E*F``."""
+    return LoopNestSpec(
+        name=f"3mm{n}",
+        arrays=(
+            ("E", n * n), ("A", n * n), ("B", n * n),
+            ("F", n * n), ("C", n * n), ("D", n * n),
+            ("G", n * n),
+        ),
+        nests=(
+            _matmul_nest(n, "E", "A", "B", init_pair=False),
+            _matmul_nest(n, "F", "C", "D", init_pair=False),
+            _matmul_nest(n, "G", "E", "F", init_pair=False),
+        ),
+    )
+
+
+def syrk(n: int = 128) -> LoopNestSpec:
+    """syrk (rectangular): ``C = beta*C + alpha*A*A^T``.
+
+    ``A1 = A[j][k]`` is the cross-thread reference: its row index j does not
+    involve the parallel iterator i, so its reuses span whole i iterations —
+    the structural twin of GEMM's B0.
+    """
+    span = share_span_formula(n)
+    c = lambda nm: Ref(nm, "C", addr_terms=((0, n), (1, 1)))
+    inner = Loop(
+        trip=n,
+        body=(
+            Ref("A0", "A", addr_terms=((0, n), (2, 1))),
+            Ref("A1", "A", addr_terms=((1, n), (2, 1)), share_span=span),
+            c("C2"),
+            c("C3"),
+        ),
+    )
+    nest = Loop(trip=n, body=(Loop(trip=n, body=(c("C0"), c("C1"), inner)),))
+    return LoopNestSpec(
+        name=f"syrk{n}",
+        arrays=(("C", n * n), ("A", n * n)),
+        nests=(nest,),
+    )

@@ -1,0 +1,84 @@
+"""Conv2d 3x3 and 7-point stencil-3D iteration spaces (BASELINE.json config 4).
+
+Non-GEMM affine nests exercising multi-term addresses with constant bases
+(neighbor offsets).  Authored in the reference's generated-sampler style (see
+``pluss.models.polybench`` docstring); the reference itself has no such kernels,
+so the share-span choice is ours: refs whose address depends on the parallel
+iterator *plus a nonzero offset* (halo rows/planes) reach across chunk
+boundaries, so they carry the cross-thread test with the generated formula
+``(trip+1)*trip+1`` of the loop just below the parallel one.
+"""
+
+from __future__ import annotations
+
+from pluss.spec import Loop, LoopNestSpec, Ref, share_span_formula
+
+
+def conv2d(n: int = 128) -> LoopNestSpec:
+    """3x3 convolution: ``out[i][j] = sum_{di,dj} W[di][dj] * in[i+di][j+dj]``.
+
+    ``in`` is n x n, ``out`` is (n-2) x (n-2), W is 3x3.  Per (i,j): 9
+    interleaved (W load, in load) pairs then the out store.
+    """
+    m = n - 2
+    span = share_span_formula(m)
+    body = []
+    for di in range(3):
+        for dj in range(3):
+            body.append(Ref(f"W{di}{dj}", "W", addr_terms=(), addr_base=di * 3 + dj))
+            body.append(
+                Ref(
+                    f"I{di}{dj}",
+                    "in",
+                    addr_terms=((0, n), (1, 1)),
+                    addr_base=di * n + dj,
+                    share_span=span if di != 0 else None,
+                )
+            )
+    body.append(Ref("O0", "out", addr_terms=((0, m), (1, 1))))
+    nest = Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),))
+    return LoopNestSpec(
+        name=f"conv2d{n}",
+        arrays=(("out", m * m), ("in", n * n), ("W", 9)),
+        nests=(nest,),
+    )
+
+
+def stencil3d(n: int = 32) -> LoopNestSpec:
+    """7-point 3D stencil: center + 6 face neighbors, parallel over i planes.
+
+    ``in``/``out`` are n^3; interior (n-2)^3 is updated.  Neighbor loads are
+    emitted center-first then -i,+i,-j,+j,-k,+k, followed by the out store.
+    The +/-i plane neighbors carry the cross-thread span.
+    """
+    m = n - 2
+    span = share_span_formula(m)
+    off = lambda di, dj, dk: (di + 1) * n * n + (dj + 1) * n + (dk + 1)
+    terms = ((0, n * n), (1, n), (2, 1))
+    body = [Ref("S000", "in", addr_terms=terms, addr_base=off(0, 0, 0))]
+    for name, (di, dj, dk) in (
+        ("SmI", (-1, 0, 0)), ("SpI", (1, 0, 0)),
+        ("SmJ", (0, -1, 0)), ("SpJ", (0, 1, 0)),
+        ("SmK", (0, 0, -1)), ("SpK", (0, 0, 1)),
+    ):
+        body.append(
+            Ref(
+                name,
+                "in",
+                addr_terms=terms,
+                addr_base=off(di, dj, dk),
+                share_span=span if di != 0 else None,
+            )
+        )
+    body.append(
+        Ref("O0", "out", addr_terms=((0, m * m), (1, m), (2, 1)))
+    )
+    nest = Loop(
+        trip=m,
+        body=(Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),)),),
+    )
+    return LoopNestSpec(
+        name=f"stencil3d{n}",
+        arrays=(("out", m * m * m), ("in", n * n * n)),
+        nests=(nest,),
+    )

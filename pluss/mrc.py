@@ -1,0 +1,116 @@
+"""AET -> miss-ratio curve, exact C++ semantics in closed form.
+
+The reference's ``pluss_AET`` (``/root/reference/c_lib/test/runtime/
+pluss_utils.h:758-804``) computes P(reuse > t) from the final reuse-interval
+histogram, then sweeps cache sizes c advancing a scalar time cursor while
+``sum_P < c`` — an O(max_reuse) serial loop.  (The Rust port ``utils.rs:21-86``
+iterates keys in the wrong direction and is dead code — SURVEY.md Q4; this
+module implements the C++ semantics.)
+
+Because P is a step function over histogram keys, the cursor's running sum is
+piecewise *linear* in t, so the first t with ``S(t) >= c`` has a closed form per
+segment and the whole curve falls out of a searchsorted — no serial sweep.  The
+per-step float accumulation of the reference is reproduced to ~1e-13 relative
+(repeated-add vs multiply rounding), far inside the 1e-5 dedup epsilon and the
+1% L2 acceptance bar (BASELINE.md north star).
+
+P construction (pluss_utils.h:761-781): iterate keys descending, excluding the
+cold key -1 but *seeding* the accumulator with its count; P[k] = acc/total
+before adding k's own count; finally P[0] is forced to 1.0.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pluss.config import MRC_DEDUP_EPS, SamplerConfig, DEFAULT
+
+
+def survival(rihist: dict) -> tuple[np.ndarray, np.ndarray]:
+    """(keys ascending, P values) of the AET survival map, C++ semantics."""
+    total = float(sum(rihist.values()))
+    if total == 0.0:
+        return np.array([0], np.int64), np.array([1.0])
+    keys = sorted(k for k in rihist if k != -1)
+    acc = float(rihist.get(-1, 0.0))
+    P = {}
+    for k in reversed(keys):
+        P[k] = acc / total
+        acc += float(rihist[k])
+    P[0] = 1.0  # pluss_utils.h:781 overwrites/creates key 0
+    ks = np.array(sorted(P), np.int64)
+    vs = np.array([P[int(k)] for k in ks])
+    return ks, vs
+
+
+def aet_mrc(rihist: dict, cfg: SamplerConfig = DEFAULT) -> np.ndarray:
+    """Miss ratio per cache size c = 0..min(max_key, cache entries).
+
+    Returns ``mrc`` with ``mrc[c]`` = the value the reference stores in
+    ``_MRC[c]`` (pluss_utils.h:786-802).  Empty histogram -> one-point [1.0].
+    """
+    if not rihist:
+        return np.array([1.0])
+    max_rt = max(rihist.keys())
+    if max_rt < 0:
+        return np.array([1.0])
+    ks, vs = survival(rihist)
+
+    # segments [ks[j], ks[j+1]-1] with constant step value vs[j]; the cursor
+    # never passes max_rt (`t <= max_RT` guard, pluss_utils.h:787)
+    ends = np.append(ks[1:] - 1, max_rt)
+    lens = (ends - ks + 1).astype(np.float64)
+    seg_cum = np.cumsum(vs * lens)            # S at each segment end
+
+    c_max = min(max_rt, cfg.aet_cache_entries)
+    cs = np.arange(0, c_max + 1, dtype=np.float64)
+    j = np.searchsorted(seg_cum, cs, side="left")
+    j = np.minimum(j, len(ks) - 1)
+    prev_cum = np.where(j > 0, seg_cum[j - 1], 0.0)
+    # first t in segment j with S(t) >= c: t = ks[j] + ceil((c-prev)/v) - 1
+    # v > 0 whenever need > 0 (a zero-step segment cannot be the first to reach c)
+    v = vs[j]
+    need = np.maximum(cs - prev_cum, 0.0)
+    steps = np.ceil(need / np.where(v > 0, v, 1.0))
+    t = ks[j] + np.maximum(steps - 1, 0).astype(np.int64)
+    t = np.minimum(t, max_rt)
+    # MRC[c] = P at the largest key <= t* (the cursor's prev_t)
+    seg_of_t = np.searchsorted(ks, t, side="right") - 1
+    return vs[seg_of_t]
+
+
+def dedup_lines(mrc: np.ndarray) -> list[tuple[int, float]]:
+    """The reference's run-collapsing printer (pluss_utils.h:851-883): for each
+    run of c whose miss ratios differ from the run head by < 1e-5, print the
+    head and (if distinct) the tail."""
+    n = len(mrc)
+    lines: list[tuple[int, float]] = []
+    i1 = 0
+    while i1 < n:
+        i2 = i1
+        while i2 + 1 < n and mrc[i1] - mrc[i2 + 1] < MRC_DEDUP_EPS:
+            i2 += 1
+        lines.append((i1, float(mrc[i1])))
+        if i1 != i2:
+            lines.append((i2, float(mrc[i2])))
+        i1 = i2 + 1
+    return lines
+
+
+def write_mrc(path: str, mrc: np.ndarray) -> None:
+    """``pluss_write_mrc_to_file`` (pluss_utils.h:885-913)."""
+    with open(path, "w") as f:
+        f.write("miss ratio\n")
+        for c, v in dedup_lines(mrc):
+            f.write(f"{c}, {v:g}\n")
+
+
+def l2_error(a: np.ndarray, b: np.ndarray) -> float:
+    """Relative L2 distance on the common prefix — the acceptance metric
+    (BASELINE.md: MRC within 1% L2 error)."""
+    n = min(len(a), len(b))
+    if n == 0:
+        return 0.0
+    x, y = np.asarray(a[:n], float), np.asarray(b[:n], float)
+    denom = float(np.linalg.norm(y)) or 1.0
+    return float(np.linalg.norm(x - y)) / denom

@@ -1,0 +1,122 @@
+"""Reuse-interval extraction as sorting — the TPU replacement for LAT hashmaps.
+
+The reference discovers reuse intervals by walking the access stream one
+reference at a time through per-thread ``HashMap<addr, last_time>`` tables
+(``/root/reference/src/gemm_sampler.rs:123-133``: probe, ``reuse = count -
+LAT[addr]``, store, tick).  That is an inherently sequential O(stream) pointer
+chase — the worst possible shape for a TPU.
+
+Key observation: the reuse interval of an access is just the gap to the
+*previous position of the same cache line*.  Sorting the stream by
+``(line, position)`` places every line's accesses consecutively in position
+order, so one vectorized subtraction yields every reuse interval at once, and
+first-touches (= the reference's end-of-run cold flush, ``gemm_sampler.rs:48-53``)
+are exactly the sort-segment heads.  No carried state, fully parallel, and the
+same code path serves generated affine streams and raw replayed traces.
+
+All arrays are int32: per-thread stream positions are < 2^31 (a 2-billion-access
+walk per simulated thread) and lexicographic two-key ``lax.sort`` avoids the
+packed-int64 keys a single-key sort would need.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pluss.config import NBINS
+
+#: sentinel line id that sorts after every real line (padding & non-events)
+LINE_SENTINEL = jnp.int32(2**31 - 1)
+
+
+def log2_bin(reuse: jnp.ndarray) -> jnp.ndarray:
+    """Slot index of the reference's log2 binning: reuse in [2^e, 2^{e+1}) -> 1+e.
+
+    Matches ``_polybench_to_highest_power_of_two`` (utils.rs:119-132) which keeps
+    only the top set bit; slot 0 is reserved for the cold key -1.
+    """
+    e = 31 - jax.lax.clz(jnp.maximum(reuse, 1).astype(jnp.int32))
+    return (1 + e).astype(jnp.int32)
+
+
+def reuse_events(line: jnp.ndarray, pos: jnp.ndarray, span: jnp.ndarray,
+                 valid: jnp.ndarray):
+    """Compute reuse events of one thread's access stream.
+
+    Args:
+      line:  [E] int32 global cache-line ids.
+      pos:   [E] int32 stream positions (the per-thread logical clock value of
+             each access; need not arrive in position order).
+      span:  [E] int32 share-test span of the access's static reference
+             (0 = the reference carries no cross-thread test).
+      valid: [E] bool, False for padding.
+
+    Returns dict of [E]-aligned (sorted order) arrays:
+      reuse:   int32 gap to previous same-line access (undefined where ~has_prev)
+      has_prev: bool — a reuse interval was observed
+      first:   bool — first touch of a line (contributes to the cold count)
+      share:   bool — reuse classified cross-thread by the reference's
+               ``distance_to(reuse,0) > distance_to(reuse,span)`` test, which for
+               integers is exactly ``2*reuse > span`` (gemm_sampler.rs:199).
+    """
+    key = jnp.where(valid, line, LINE_SENTINEL)
+    key_s, pos_s, span_s, valid_s = jax.lax.sort(
+        (key, pos, span, valid.astype(jnp.int32)), num_keys=2
+    )
+    same = jnp.concatenate(
+        [jnp.zeros((1,), bool), key_s[1:] == key_s[:-1]]
+    )
+    prev_pos = jnp.concatenate([pos_s[:1], pos_s[:-1]])
+    valid_b = valid_s.astype(bool)
+    has_prev = same & valid_b
+    reuse = jnp.where(has_prev, pos_s - prev_pos, 0).astype(jnp.int32)
+    first = valid_b & ~same
+    share = has_prev & (span_s > 0) & (2 * reuse > span_s)
+    return {
+        "reuse": reuse,
+        "has_prev": has_prev,
+        "first": first,
+        "share": share,
+    }
+
+
+def noshare_histogram(ev: dict) -> jnp.ndarray:
+    """[NBINS] int32 dense histogram: slot 0 = cold (-1), slot 1+e = key 2^e.
+
+    Cold weight = number of first touches = the LAT table sizes the reference
+    flushes at the end (gemm_sampler.rs:48-53); no-share reuses are binned at
+    insert (utils.rs:106-107, Q6).
+    """
+    evt = ev["has_prev"] & ~ev["share"]
+    # reuse events land in their log2 slot (>=1); first-touches in the cold slot 0
+    bins = jnp.where(evt, log2_bin(ev["reuse"]), 0)
+    w = jnp.where(ev["first"] | evt, 1, 0).astype(jnp.int32)
+    return jax.ops.segment_sum(w, bins, num_segments=NBINS)
+
+
+def share_unique(ev: dict, cap: int):
+    """Fixed-capacity (value, count) extraction of raw share reuses.
+
+    The reference keeps share reuses unbinned until the racetrack post-pass
+    (pluss_utils.h:928-937, Q6), so the engine must return exact values.  Share
+    events are sorted; segment boundaries give the unique values and a
+    segment-sum the counts.
+
+    Returns (vals [cap] int32, counts [cap] int32, n_unique int32).  If
+    ``n_unique > cap`` the trailing uniques were dropped; callers must check.
+    """
+    sv = jnp.where(ev["share"], ev["reuse"], LINE_SENTINEL)
+    sv = jax.lax.sort(sv)
+    is_evt = sv != LINE_SENTINEL
+    boundary = jnp.concatenate([is_evt[:1], (sv[1:] != sv[:-1]) & is_evt[1:]])
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg = jnp.where(is_evt, seg, cap)  # padding -> overflow slot
+    counts = jax.ops.segment_sum(
+        is_evt.astype(jnp.int32), seg, num_segments=cap + 1
+    )[:cap]
+    vals = jnp.zeros((cap + 1,), jnp.int32).at[seg].set(
+        jnp.where(is_evt, sv, 0), mode="drop"
+    )[:cap]
+    n_unique = boundary.sum().astype(jnp.int32)
+    return vals, counts, n_unique

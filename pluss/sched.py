@@ -1,0 +1,185 @@
+"""Chunk-scheduling math in closed form (the reference's ChunkDispatcher).
+
+The reference's ``ChunkDispatcher`` (``/root/reference/c_lib/test/runtime/
+pluss_utils.h:287-618``; Rust subset ``src/chunk_dispatcher.rs``) is a stateful
+object queried one chunk at a time inside the hot loop.  On TPU the same
+semantics become closed-form index arithmetic evaluated for whole iteration
+grids at once; this module provides both a small stateless Python API (used by
+tests and the oracle) and the formulas the XLA engine inlines.
+
+Static scheduling (the live path, ``pluss_utils.h:410-425``): thread ``t``'s
+k-th chunk starts at ``start + chunk_size*step*(t + k*T)``; i.e. chunk id
+``cid`` (0-based over the whole loop) is served by thread ``cid % T``.
+
+Dynamic scheduling (C++-only capability, ``pluss_utils.h:393-408``): chunks are
+handed out FIFO to whichever thread asks next.  Under the reference's uniform
+interleaving assumption every thread requests in round-robin order, which makes
+the dynamic assignment identical to the static one; other request orders can be
+modelled by an explicit chunk->thread assignment vector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkSchedule:
+    """Closed-form view of one parallel loop's chunking.
+
+    Mirrors constructor ``ChunkDispatcher(chunk_size, trip, start_point, step)``
+    (``pluss_utils.h:325-334``): ``trip`` iterations starting at value ``start``
+    with stride ``step``; ``last = start + (trip-1)*step``.
+    """
+
+    chunk_size: int
+    trip: int
+    start: int = 0
+    step: int = 1
+    thread_num: int = 4
+
+    @property
+    def last(self) -> int:
+        return self.start + (self.trip - 1) * self.step
+
+    @property
+    def n_chunks(self) -> int:
+        """``avail_chunk`` (pluss_utils.h:300)."""
+        return -(-self.trip // self.chunk_size)
+
+    # -- per-chunk geometry ---------------------------------------------------
+
+    def chunk_index_range(self, cid: int) -> tuple[int, int]:
+        """[begin, end) of chunk ``cid`` in iteration-index space (0..trip)."""
+        b = cid * self.chunk_size
+        return b, min(b + self.chunk_size, self.trip)
+
+    def chunk_bounds(self, cid: int) -> tuple[int, int]:
+        """(lb, ub) inclusive in *value* space, as ``getNextStaticChunk`` returns
+        (pluss_utils.h:410-425): for step>0 ub is clamped to ``last``."""
+        b, e = self.chunk_index_range(cid)
+        v0 = self.start + b * self.step
+        v1 = self.start + (e - 1) * self.step
+        return (v0, v1) if self.step > 0 else (v1, v0)
+
+    # -- static scheduling ----------------------------------------------------
+
+    def chunk_owner(self, cid: int) -> int:
+        """Static owner thread of chunk ``cid``: round-robin (pluss_utils.h:312,420)."""
+        return cid % self.thread_num
+
+    def chunks_of_thread(self, tid: int) -> list[int]:
+        return list(range(tid, self.n_chunks, self.thread_num))
+
+    def n_chunks_of_thread(self, tid: int) -> int:
+        return len(self.chunks_of_thread(tid))
+
+    def max_rounds(self) -> int:
+        """Max chunks any single thread serves (vmap/pad bound for the engine)."""
+        return -(-self.n_chunks // self.thread_num) if self.n_chunks else 0
+
+    def thread_iteration_indices(self, tid: int) -> list[int]:
+        """All iteration indices (0..trip) of thread ``tid`` in execution order."""
+        out = []
+        for cid in self.chunks_of_thread(tid):
+            b, e = self.chunk_index_range(cid)
+            out.extend(range(b, e))
+        return out
+
+    def thread_iteration_values(self, tid: int) -> list[int]:
+        return [self.start + i * self.step for i in self.thread_iteration_indices(tid)]
+
+    # -- iteration -> (round, tid, pos) decomposition -------------------------
+    # These mirror the sampling-support API of the C++ dispatcher.
+
+    def static_tid(self, i: int) -> int:
+        """``getStaticTid`` (pluss_utils.h:429-431)."""
+        idx = (i - self.start) // self.step
+        return idx // self.chunk_size - (
+            idx // (self.chunk_size * self.thread_num)
+        ) * self.thread_num
+
+    def static_chunk_id(self, i: int) -> int:
+        """``getStaticChunkID`` — the thread-local *round*, not the global cid
+        (pluss_utils.h:433-435; src/iteration.rs:33)."""
+        return (i - self.start) // self.step // (self.chunk_size * self.thread_num)
+
+    def static_thread_local_pos(self, i: int) -> int:
+        """``getStaticThreadLocalPos`` (pluss_utils.h:437-439)."""
+        return (i - self.start) // self.step % self.chunk_size
+
+    def local_rank(self, i: int) -> int:
+        """Rank of iteration value ``i`` within its owner thread's stream.
+
+        Valid because only the globally-last chunk can be partial, so all
+        earlier chunks of the owner are full:
+        ``rank = round*chunk_size + pos``.
+        """
+        return self.static_chunk_id(i) * self.chunk_size + self.static_thread_local_pos(i)
+
+    # -- resume / start-point API (checkpoint-resume capability) --------------
+
+    def chunks_of_thread_from(self, tid: int, i: int) -> list[int]:
+        """Chunk ids thread ``tid`` still serves when sampling resumes at
+        iteration value ``i`` — ``setStartPoint`` semantics (pluss_utils.h:443-472):
+        every thread's start point advances by ``start_round`` full rounds."""
+        start_round = self.static_chunk_id(i)
+        first = start_round * self.thread_num + tid
+        return [c for c in range(first, self.n_chunks, self.thread_num) if c >= 0]
+
+    def start_chunk_of(self, i: int) -> int:
+        """Global chunk id containing iteration value ``i`` (``getStartChunk``
+        rounding, pluss_utils.h:492-516)."""
+        return (i - self.start) // self.step // self.chunk_size
+
+    def next_k_chunks(self, k: int, cid: int) -> list[int]:
+        """``getNextKChunksFrom`` (pluss_utils.h:518-552) in chunk-id space."""
+        return [c for c in range(cid + 1, min(cid + 1 + k, self.n_chunks))]
+
+    def prev_k_chunks(self, k: int, cid: int) -> list[int]:
+        """``getPrevKChunksFrom`` (pluss_utils.h:554-587) in chunk-id space."""
+        return [c for c in range(cid - 1, max(cid - 1 - k, -1), -1)]
+
+    # -- dynamic scheduling ---------------------------------------------------
+
+    def dynamic_assignment(self, request_order: list[int] | None = None) -> list[int]:
+        """Chunk -> thread map under FIFO dynamic scheduling
+        (``getNextChunk``, pluss_utils.h:393-408).
+
+        ``request_order``: the sequence of thread ids asking for chunks; defaults
+        to round-robin, which reproduces the uniform-interleaving assumption and
+        equals the static map.
+        """
+        n = self.n_chunks
+        if request_order is None:
+            return [c % self.thread_num for c in range(n)]
+        if len(request_order) < n:
+            raise ValueError("request_order shorter than number of chunks")
+        return list(request_order[:n])
+
+
+def chunks_check(trip: int, chunk_size: int) -> int:
+    return -(-trip // chunk_size)
+
+
+def iteration_value_grid(sched: ChunkSchedule, tid: int):
+    """(rounds, chunk_size) grids used by the XLA engine, as plain Python lists:
+    for round r and in-chunk pos p of thread ``tid``:
+
+    - global index  ``g = (r*T + tid)*CS + p``  (valid iff g < trip)
+    - value         ``v = start + g*step``
+    - local rank    ``rank = r*CS + p``
+
+    The engine computes the same with ``jax.lax.iota``; this helper exists for
+    tests to cross-check the formulas against ``thread_iteration_indices``.
+    """
+    T, CS = sched.thread_num, sched.chunk_size
+    rows = []
+    for r in range(sched.max_rounds()):
+        row = []
+        for p in range(CS):
+            g = (r * T + tid) * CS + p
+            row.append((g, sched.start + g * sched.step, r * CS + p, g < sched.trip))
+        rows.append(row)
+    return rows

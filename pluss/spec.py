@@ -1,0 +1,201 @@
+"""Loop-nest & reference specs: the declarative replacement for generated samplers.
+
+The reference encodes each workload as compiler-*generated state-machine code*
+(e.g. the GEMM walk in ``/root/reference/src/gemm_sampler.rs:56-293`` and
+``c_lib/test/sampler/gemm-t4-pluss-pro-model-ri-omp.cpp:37-333``): one hardcoded
+``if ref == "C0" ...`` block per static reference, with the iteration vector
+mutated in place.  That design needs new generated code per workload and walks one
+access at a time.
+
+Here a workload is a small declarative tree of :class:`Loop` and :class:`Ref`
+nodes.  Because every loop is rectangular (constant trip count), the *position in
+the access stream* and the *element address* of every occurrence of every static
+reference are affine functions of the iteration vector.  The XLA engine
+(:mod:`pluss.engine`) exploits that to enumerate whole reference streams with
+broadcasted ``iota`` arithmetic — no per-access control flow, no state machine.
+
+Semantics preserved from the reference:
+
+- Program order of references inside a loop body = their order in ``Loop.body``
+  (the reference's ref priority / topological order, ``src/iteration.rs:123-129``).
+- One logical clock per simulated thread, incremented once per access
+  (``gemm_sampler.rs:133``: ``count[tid] += 1`` in every state).
+- Share classification happens per *static reference* with a span threshold:
+  a reuse is "share" (crosses threads) iff it is closer to the carrying-loop span
+  than to 0 — ``distance_to(reuse,0) > distance_to(reuse,span)``
+  (``gemm_sampler.rs:199``, ``…omp.cpp:203``).  For integer reuse/span this is
+  exactly ``2*reuse > span``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Union
+
+from pluss.config import SamplerConfig, DEFAULT
+
+
+@dataclasses.dataclass(frozen=True)
+class Ref:
+    """One static memory reference inside a loop body.
+
+    ``addr_terms`` maps *loop depth* (0 = the nest's outermost/parallel loop) to
+    the row-major address coefficient; the element address of an occurrence is
+    ``addr_base + sum(coef * iv[depth])`` over the terms, with ``iv`` the actual
+    iteration *values* (``start + step*index``), matching the reference's
+    ``GetAddress_*`` functions (``…omp.cpp:12-35``).
+
+    ``share_span``: if not None, reuses observed at this reference are tested for
+    cross-thread sharing against this span (see module docstring).  The GEMM
+    value 16513 comes from the generated comment ``(((1)*((128-0)/1)+1)*((128-0)/1)+1)``
+    (``…omp.cpp:202``), i.e. ``(trip+1)*trip + 1`` of the carrying loop.
+    """
+
+    name: str
+    array: str
+    addr_terms: tuple[tuple[int, int], ...]
+    addr_base: int = 0
+    share_span: int | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Loop:
+    """A rectangular loop: ``for iv in (start, start+step, ...) x trip: body``.
+
+    ``body`` is an ordered tuple of :class:`Ref` and nested :class:`Loop` items,
+    executed in order each iteration.
+    """
+
+    trip: int
+    body: tuple[Union["Loop", Ref], ...]
+    start: int = 0
+    step: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class LoopNestSpec:
+    """A workload: a sequence of parallel loop nests over named arrays.
+
+    ``arrays``: (name, total elements) per array, in declaration order.  The
+    cold-miss flush order of the reference (C, then A, then B for GEMM —
+    ``gemm_sampler.rs:280-282``) is the order of this tuple.
+
+    ``nests``: each entry is one ``#pragma pluss parallel`` loop
+    (``c_lib/test/gemm.ppcg_omp.c:90``); its outermost dimension is chunked over
+    simulated threads by the dispatcher.  Nests execute back-to-back; per-thread
+    clocks and last-access tables persist across nests and are flushed once at
+    the end, matching the generated sampler pattern (``…omp.cpp:306-319``).
+    """
+
+    name: str
+    arrays: tuple[tuple[str, int], ...]
+    nests: tuple[Loop, ...]
+
+    def array_index(self, name: str) -> int:
+        for i, (a, _) in enumerate(self.arrays):
+            if a == name:
+                return i
+        raise KeyError(name)
+
+    def line_counts(self, cfg: SamplerConfig = DEFAULT) -> list[int]:
+        """Cache lines per array: ceil(elements * DS / CLS)."""
+        return [-(-n * cfg.ds // cfg.cls) for _, n in self.arrays]
+
+    def line_bases(self, cfg: SamplerConfig = DEFAULT) -> list[int]:
+        """Exclusive prefix sum of line_counts: global line-id base per array."""
+        bases, acc = [], 0
+        for n in self.line_counts(cfg):
+            bases.append(acc)
+            acc += n
+        return bases
+
+    def total_lines(self, cfg: SamplerConfig = DEFAULT) -> int:
+        return sum(self.line_counts(cfg))
+
+
+def loop_size(item: Union[Loop, Ref]) -> int:
+    """Total accesses performed by one execution of ``item``."""
+    if isinstance(item, Ref):
+        return 1
+    return item.trip * sum(loop_size(b) for b in item.body)
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatRef:
+    """A reference flattened against its enclosing loop chain.
+
+    For occurrence with per-level indices ``idx[0..d]`` (index space, not value
+    space) the stream position inside one execution of the nest is::
+
+        pos = offset + sum(idx[l] * pos_stride[l])
+
+    and the element address is::
+
+        addr = addr_base + sum(addr_coef[l] * (start[l] + step[l]*idx[l]))
+
+    ``pos_stride[l]`` is the access count of one iteration of loop ``l``'s body.
+    """
+
+    ref: Ref
+    trips: tuple[int, ...]
+    starts: tuple[int, ...]
+    steps: tuple[int, ...]
+    pos_strides: tuple[int, ...]
+    offset: int
+    addr_coefs: tuple[int, ...]  # dense, one per enclosing loop depth
+
+
+def flatten_nest(nest: Loop) -> list[FlatRef]:
+    """Flatten one parallel nest into per-reference affine occurrence specs."""
+    out: list[FlatRef] = []
+
+    def walk(loop: Loop, chain: list[Loop], offset: int) -> None:
+        chain = chain + [loop]
+        body_off = 0
+        for item in loop.body:
+            if isinstance(item, Ref):
+                trips = tuple(l.trip for l in chain)
+                starts = tuple(l.start for l in chain)
+                steps = tuple(l.step for l in chain)
+                strides = tuple(sum(loop_size(b) for b in l.body) for l in chain)
+                coefs = [0] * len(chain)
+                for depth, coef in item.addr_terms:
+                    if depth >= len(chain):
+                        raise ValueError(
+                            f"ref {item.name}: addr term depth {depth} exceeds "
+                            f"loop chain depth {len(chain)}"
+                        )
+                    coefs[depth] += coef
+                out.append(
+                    FlatRef(
+                        ref=item,
+                        trips=trips,
+                        starts=starts,
+                        steps=steps,
+                        pos_strides=strides,
+                        offset=offset + body_off,
+                        addr_coefs=tuple(coefs),
+                    )
+                )
+                body_off += 1
+            else:
+                walk(item, chain, offset + body_off)
+                body_off += loop_size(item)
+
+    walk(nest, [], 0)
+    return out
+
+
+def nest_iteration_size(nest: Loop) -> int:
+    """Accesses per iteration of the nest's outermost (parallel) loop."""
+    return sum(loop_size(b) for b in nest.body)
+
+
+def share_span_formula(trip: int, start: int = 0, step: int = 1) -> int:
+    """The generated share-threshold: ``((1*((trip-start)/step)+1)*((trip-start)/step)+1)``.
+
+    From the generated comparison at ``…omp.cpp:202`` /
+    ``gemm_sampler.rs:198-199`` — for GEMM-128 this is 129*128+1 = 16513.
+    """
+    t = (trip - start) // step
+    return (t + 1) * t + 1

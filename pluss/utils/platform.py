@@ -1,0 +1,53 @@
+"""Backend/platform helpers.
+
+This image registers an ``axon`` (tunneled TPU) PJRT backend from
+``sitecustomize`` at interpreter startup and force-updates
+``jax_platforms="axon,cpu"``, overriding the ``JAX_PLATFORMS`` env var.  CPU-only
+work (tests, the virtual multi-device mesh) must therefore re-force the config
+*after* startup, and before the first backend initialization if possible.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_virtual_devices: int | None = None) -> None:
+    """Pin JAX to the host CPU platform, optionally with N virtual devices.
+
+    Safe to call multiple times; clears already-initialized backends when the
+    platform set actually changes (pre-existing arrays keep working per JAX
+    semantics, but none should exist when this is used as intended — at
+    process/test-session start).
+    """
+    if n_virtual_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={n_virtual_devices}"
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+        elif want not in flags:
+            import re
+
+            os.environ["XLA_FLAGS"] = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want, flags
+            )
+    import jax
+
+    if jax.config.jax_platforms != "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+
+
+def has_accelerator() -> bool:
+    """True when a non-CPU backend is reachable (used by the benchmark)."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except Exception:
+        return False

@@ -1,0 +1,17 @@
+"""Test env: force an 8-device virtual CPU platform.
+
+Multi-chip hardware is unavailable in CI; sharding semantics are validated on a
+virtual 8-device CPU mesh exactly as SURVEY.md §7 prescribes.  The env vars are
+set before JAX initializes AND the config is re-forced afterwards because this
+image's sitecustomize registers a tunneled TPU backend that overrides
+``JAX_PLATFORMS`` at startup.  f64 stays enabled: the CRI/statistics pipeline
+matches C++ doubles (SURVEY.md §7 hard part 5).
+"""
+
+import os
+
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+from pluss.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(n_virtual_devices=8)

@@ -1,0 +1,284 @@
+"""Pure-Python oracle: exact reference semantics, dict-based, slow, obvious.
+
+This is the test oracle SURVEY.md §4 calls for: a literal re-enactment of the
+reference's sampler walk (``/root/reference/src/gemm_sampler.rs:56-293``) and CRI
+post-pass (``src/utils.rs``, ``c_lib/test/runtime/pluss_utils.h:986-1208``),
+generalized over :class:`pluss.spec.LoopNestSpec` but keeping every behavioral
+quirk (SURVEY.md §5 quirk register):
+
+- per-thread logical clocks incremented once per access;
+- per-(thread, array) last-access-time dicts, flushed to cold key -1 with
+  weight = table size at the end (``gemm_sampler.rs:48-53``);
+- no-share reuses log2-binned at insert, share reuses kept raw (Q6);
+- share test ``distance_to(reuse,0) > distance_to(reuse,span)``;
+- NBD dilation with the 4000*(T-1)/T point-mass cutoff and 0.9999 mass rule;
+- racetrack bin split with the last-bin residual *overwrite*
+  (``pluss_utils.h:1088-1093``: ``prob[i-1] = 1 - prob_sum`` replaces the last
+  computed bin rather than adding to it);
+- AET sweep and MRC dedup printing per ``pluss_utils.h:758-883``.
+
+Unlike the reference's Rust binary (Q1), state is per-run: each call returns
+fresh results.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+
+from pluss.config import (
+    NBD_CUTOFF_COEF,
+    NBD_MASS_CUT,
+    MRC_DEDUP_EPS,
+    SamplerConfig,
+    DEFAULT,
+)
+from pluss.sched import ChunkSchedule
+from pluss.spec import Loop, LoopNestSpec, Ref
+
+
+def to_highest_power_of_two(x: int) -> int:
+    """``_polybench_to_highest_power_of_two`` (utils.rs:119-132) for x >= 1."""
+    return 1 << (x.bit_length() - 1)
+
+
+def histogram_update(hist: dict, reuse: int, cnt: float, in_log_format: bool = True):
+    if reuse > 0 and in_log_format:
+        reuse = to_highest_power_of_two(reuse)
+    hist[reuse] = hist.get(reuse, 0.0) + cnt
+
+
+class OracleSampler:
+    """Walks the spec exactly as the generated state machine would."""
+
+    def __init__(self, spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT):
+        self.spec = spec
+        self.cfg = cfg
+        T = cfg.thread_num
+        self.noshare = [dict() for _ in range(T)]          # _NoSharePRI
+        self.share = [defaultdict(dict) for _ in range(T)]  # _SharePRI
+        self.count = [0] * T
+        self.lat = [
+            {name: {} for name, _ in spec.arrays} for _ in range(T)
+        ]
+
+    def _access(self, tid: int, ref: Ref, ivs: list[int]):
+        addr = ref.addr_base + sum(c * ivs[d] for d, c in ref.addr_terms)
+        line = addr * self.cfg.ds // self.cfg.cls
+        lat = self.lat[tid][ref.array]
+        if line in lat:
+            reuse = self.count[tid] - lat[line]
+            if ref.share_span is not None and abs(reuse - 0) > abs(reuse - ref.share_span):
+                ratio = self.cfg.thread_num - 1
+                # share insert keeps the raw reuse (pluss_utils.h:928-937)
+                h = self.share[tid][ratio]
+                h[reuse] = h.get(reuse, 0.0) + 1.0
+            else:
+                histogram_update(self.noshare[tid], reuse, 1.0)
+        lat[line] = self.count[tid]
+        self.count[tid] += 1
+
+    def _walk_dispatch(self, tid: int, item, ivs: list[int]):
+        if isinstance(item, Ref):
+            self._access(tid, item, ivs)
+        else:
+            for i in range(item.trip):
+                v = item.start + i * item.step
+                for b in item.body:
+                    self._walk_dispatch(tid, b, ivs + [v])
+
+    def run(self):
+        cfg = self.cfg
+        for nest in self.spec.nests:
+            sched = ChunkSchedule(
+                cfg.chunk_size, nest.trip, nest.start, nest.step, cfg.thread_num
+            )
+            for tid in range(cfg.thread_num):
+                for v in sched.thread_iteration_values(tid):
+                    for b in nest.body:
+                        self._walk_dispatch(tid, b, [v])
+        # cold flush, array-declaration order (gemm_sampler.rs:280-282)
+        for name, _ in self.spec.arrays:
+            for tid in range(cfg.thread_num):
+                histogram_update(
+                    self.noshare[tid], -1, float(len(self.lat[tid][name]))
+                )
+                self.lat[tid][name].clear()
+        return self
+
+    @property
+    def max_iteration_count(self) -> int:
+        return sum(self.count)
+
+
+# ---------------------------------------------------------------------------
+# CRI model (exact reference semantics)
+# ---------------------------------------------------------------------------
+
+def nbd_pmf(k: int, r: float, p: float) -> float:
+    """NegativeBinomial(r, p) pmf at k — GSL's ``gsl_ran_negative_binomial_pdf
+    (k, p, n)`` (pluss_utils.h:1002) == statrs' parameterization (utils.rs:226-228):
+    ``C(k+r-1, k) * p^r * (1-p)^k``, via lgamma for stability."""
+    if k < 0:
+        return 0.0
+    return math.exp(
+        math.lgamma(k + r)
+        - math.lgamma(k + 1.0)
+        - math.lgamma(r)
+        + r * math.log(p)
+        + k * math.log1p(-p)
+    )
+
+
+def cri_nbd(thread_cnt: int, n: int, dist: dict):
+    """``_pluss_cri_nbd`` (utils.rs:213-236, pluss_utils.h:987-1009)."""
+    p = 1.0 / thread_cnt
+    if n >= NBD_CUTOFF_COEF * (thread_cnt - 1) / thread_cnt:
+        dist[n * thread_cnt] = 1.0
+        return
+    k, prob_sum = 0, 0.0
+    while True:
+        prob = nbd_pmf(k, float(n), p)
+        prob_sum += prob
+        dist[k + n] = prob
+        if prob_sum > NBD_MASS_CUT:
+            break
+        k += 1
+
+
+def cri_noshare_distribute(noshare: list[dict], rihist: dict, thread_cnt: int):
+    """``_pluss_cri_noshare_distribute`` (utils.rs:307-344, pluss_utils.h:1010-1039)."""
+    merged: dict = {}
+    for h in noshare:
+        for k, v in h.items():
+            merged[k] = merged.get(k, 0.0) + v
+    for k, v in merged.items():
+        if k < 0:
+            histogram_update(rihist, k, v)
+            continue
+        if thread_cnt > 1:
+            dist: dict = {}
+            cri_nbd(thread_cnt, k, dist)
+            for kk, vv in dist.items():
+                histogram_update(rihist, kk, v * vv)
+        else:
+            histogram_update(rihist, k, v)
+
+
+def cri_racetrack(share: list[dict], rihist: dict, thread_cnt: int):
+    """``_pluss_cri_racetrack`` (utils.rs:238-301, pluss_utils.h:1040-1131),
+    including the last-bin residual overwrite."""
+    merged: dict = {}
+    for h in share:
+        for n, hist in h.items():
+            m = merged.setdefault(n, {})
+            for r, c in hist.items():
+                m[r] = m.get(r, 0.0) + c
+    for n_key, hist in merged.items():
+        n = float(n_key)
+        for r, c in hist.items():
+            if thread_cnt <= 1:
+                histogram_update(rihist, r, c)
+                continue
+            dist: dict = {}
+            cri_nbd(thread_cnt, r, dist)
+            for ri, pv in dist.items():
+                cnt = c * pv
+                prob: dict = {}
+                prob_sum = 0.0
+                i = 1
+                while True:
+                    if 2.0 ** i > ri:
+                        break
+                    prob[i] = (1 - 2.0 ** (i - 1) / ri) ** n - (1 - 2.0 ** i / ri) ** n
+                    prob_sum += prob[i]
+                    i += 1
+                    if prob_sum == 1.0:
+                        break
+                if prob_sum != 1.0:
+                    prob[i - 1] = 1.0 - prob_sum  # residual OVERWRITES last bin
+                for b, bp in prob.items():
+                    new_ri = int(2.0 ** (b - 1))
+                    histogram_update(rihist, new_ri, bp * cnt)
+
+
+def cri_distribute(noshare, share, thread_cnt: int) -> dict:
+    """``pluss_cri_distribute`` (utils.rs:346-349): noshare then racetrack."""
+    rihist: dict = {}
+    cri_noshare_distribute(noshare, rihist, thread_cnt)
+    cri_racetrack(share, rihist, thread_cnt)
+    return rihist
+
+
+# ---------------------------------------------------------------------------
+# Merged dumps (what acc mode prints)
+# ---------------------------------------------------------------------------
+
+def merge_noshare(noshare: list[dict]) -> dict:
+    out: dict = {}
+    for h in noshare:
+        for k, v in h.items():
+            histogram_update(out, k, v, in_log_format=False)
+    return out
+
+
+def merge_share(share: list[dict]) -> dict:
+    out: dict = {}
+    for h in share:
+        for hist in h.values():
+            for k, v in hist.items():
+                histogram_update(out, k, v, in_log_format=False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AET -> MRC (C++ semantics, pluss_utils.h:758-804; fixes Rust port bug Q4)
+# ---------------------------------------------------------------------------
+
+def aet_mrc(rihist: dict, cache_entries: int) -> dict:
+    total = sum(rihist.values())
+    if total == 0:
+        return {}
+    max_rt = max(rihist.keys())
+    P: dict = {}
+    acc = rihist.get(-1, 0.0)
+    for k in sorted([k for k in rihist if k != -1], reverse=True):
+        P[k] = acc / total
+        acc += rihist[k]
+    P[0] = 1.0
+    mrc: dict = {}
+    sum_p, t, prev_t = 0.0, 0, 0
+    for c in range(0, max_rt + 1):
+        if c > cache_entries:
+            break
+        while sum_p < c and t <= max_rt:
+            if t in P:
+                sum_p += P[t]
+                prev_t = t
+            else:
+                sum_p += P[prev_t]
+            t += 1
+        mrc[c] = P[prev_t]
+    return mrc
+
+
+def mrc_dedup_lines(mrc: dict) -> list[tuple[int, float]]:
+    """The dedup printer (pluss_utils.h:851-883) over the ordered MRC map."""
+    keys = sorted(mrc.keys())
+    lines: list[tuple[int, float]] = []
+    i1 = 0
+    while i1 < len(keys):
+        i2 = i1
+        while True:
+            i3 = i2 + 1
+            if i3 >= len(keys):
+                break
+            if mrc[keys[i1]] - mrc[keys[i3]] < MRC_DEDUP_EPS:
+                i2 += 1
+            else:
+                break
+        lines.append((keys[i1], mrc[keys[i1]]))
+        if i1 != i2:
+            lines.append((keys[i2], mrc[keys[i2]]))
+        i1 = i2 + 1
+    return lines

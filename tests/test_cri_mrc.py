@@ -1,0 +1,102 @@
+"""Differential tests: production CRI/MRC (vectorized) vs the literal oracle."""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from pluss import cri, mrc
+from pluss.config import DEFAULT, SamplerConfig
+from tests import oracle
+
+
+def rand_noshare(rng, nkeys=8, with_cold=True):
+    h = {}
+    if with_cold:
+        h[-1] = float(rng.randint(0, 50))
+    for _ in range(nkeys):
+        h[1 << rng.randint(0, 14)] = float(rng.randint(1, 10_000))
+    return h
+
+
+def rand_share(rng, nkeys=4):
+    return {3: {rng.randint(2, 100_000): float(rng.randint(1, 5_000))
+                for _ in range(nkeys)}}
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_distribute_matches_oracle(seed):
+    rng = random.Random(seed)
+    T = rng.choice([2, 4, 8])
+    noshare = [rand_noshare(rng) for _ in range(T)]
+    share = [rand_share(rng) for _ in range(T)]
+    got = cri.distribute(noshare, share, T)
+    want = oracle.cri_distribute(
+        [dict(h) for h in noshare], [dict(h) for h in share], T
+    )
+    assert set(got) == set(want)
+    for k in want:
+        assert math.isclose(got[k], want[k], rel_tol=1e-9, abs_tol=1e-12), k
+
+
+def test_distribute_thread_cnt_1_passthrough():
+    noshare = [{4: 10.0, -1: 2.0}]
+    share = [{3: {100: 5.0}}]
+    got = cri.distribute(noshare, share, 1)
+    assert got == {4: 10.0, -1: 2.0, 64: 5.0}
+
+
+def test_nbd_dilate_point_mass_cutoff():
+    keys, pmf = cri.nbd_dilate(4, 3000)
+    assert list(keys) == [12000] and list(pmf) == [1.0]
+    keys, pmf = cri.nbd_dilate(4, 512)
+    assert keys[0] == 512
+    assert pmf.sum() > 0.9999
+    # reference stops at the crossing term: dropping the last goes below cut
+    assert pmf[:-1].sum() <= 0.9999
+
+
+def test_racetrack_bins_small_ri():
+    # ri < 2: loop body never runs; residual lands in bin 0 -> key int(2^-1)=0
+    assert cri.racetrack_bins(1, 3.0) == [(0, 1.0)]
+    # ri = 4, n = 3: bins 1..2, last overwritten by residual
+    bins = dict(cri.racetrack_bins(4, 3.0))
+    assert set(bins) == {1, 2}
+    assert math.isclose(bins[1], 0.75**3 - 0.5**3)
+    assert math.isclose(bins[2], 1 - 0.75**3)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_aet_mrc_matches_oracle(seed):
+    rng = random.Random(100 + seed)
+    rihist = {}
+    rihist[-1] = float(rng.randint(0, 100))
+    for _ in range(rng.randint(1, 12)):
+        rihist[rng.randint(1, 3000)] = float(rng.randint(1, 10_000))
+    got = mrc.aet_mrc(rihist, DEFAULT)
+    want = oracle.aet_mrc(rihist, DEFAULT.aet_cache_entries)
+    assert len(got) == len(want)
+    for c in range(len(got)):
+        assert math.isclose(got[c], want[c], rel_tol=1e-9, abs_tol=1e-12), c
+
+
+def test_aet_cache_entry_cap():
+    cfg = SamplerConfig(cache_kb=1)  # 128 doubles
+    rihist = {1: 1.0, 100000: 1.0}
+    out = mrc.aet_mrc(rihist, cfg)
+    assert len(out) == cfg.aet_cache_entries + 1
+
+
+def test_dedup_lines_match_oracle():
+    rng = random.Random(7)
+    rihist = {-1: 5.0, 2: 100.0, 64: 500.0, 1024: 50.0}
+    got_mrc = mrc.aet_mrc(rihist, DEFAULT)
+    want_lines = oracle.mrc_dedup_lines({c: got_mrc[c] for c in range(len(got_mrc))})
+    assert mrc.dedup_lines(got_mrc) == want_lines
+
+
+def test_l2_error():
+    a = np.array([1.0, 0.5, 0.25])
+    assert mrc.l2_error(a, a) == 0.0
+    assert mrc.l2_error(a, np.zeros(3)) > 0

@@ -1,0 +1,66 @@
+"""XLA engine ≡ oracle: the core differential test (SURVEY.md §4's real oracle —
+parallel semantics must equal sequential enumeration)."""
+
+import pytest
+
+from pluss.config import SamplerConfig
+from pluss.engine import run
+from pluss.models import REGISTRY, gemm
+from tests.oracle import OracleSampler, merge_noshare, merge_share
+
+
+def assert_matches_oracle(spec, cfg):
+    o = OracleSampler(spec, cfg).run()
+    r = run(spec, cfg)
+    assert r.max_iteration_count == o.max_iteration_count
+    for t in range(cfg.thread_num):
+        assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
+        got_share = r.share_dict(t)
+        want_share = {k: dict(v) for k, v in o.share[t].items() if v}
+        assert got_share == want_share, f"tid {t} share"
+
+
+SMALL_CFGS = [
+    SamplerConfig(),                      # reference constants
+    SamplerConfig(cls=8),                 # 1 element/line: rich share activity
+    SamplerConfig(thread_num=3, chunk_size=5, cls=16),
+    SamplerConfig(thread_num=8, chunk_size=2),
+]
+
+
+@pytest.mark.parametrize("cfg", SMALL_CFGS)
+def test_gemm_small_matches_oracle(cfg):
+    assert_matches_oracle(gemm(16), cfg)
+
+
+@pytest.mark.parametrize("cfg", SMALL_CFGS[:2])
+def test_gemm_odd_size_matches_oracle(cfg):
+    # trip 13 with chunk 4: partial last chunk + uneven thread loads
+    assert_matches_oracle(gemm(13), cfg)
+
+
+@pytest.mark.parametrize("name", ["2mm", "3mm", "syrk", "conv2d"])
+def test_other_kernels_match_oracle(name):
+    assert_matches_oracle(REGISTRY[name](12), SamplerConfig(cls=8))
+
+
+def test_stencil3d_matches_oracle():
+    assert_matches_oracle(REGISTRY["stencil3d"](8), SamplerConfig(cls=8))
+
+
+@pytest.mark.slow
+def test_gemm128_matches_golden():
+    from tests.test_oracle import GOLD_NOSHARE_128, GOLD_SHARE_128
+
+    r = run(gemm(128))
+    assert r.max_iteration_count == 8421376
+    noshare = {}
+    for t in range(4):
+        for k, v in r.noshare_dict(t).items():
+            noshare[k] = noshare.get(k, 0.0) + v
+    share = {}
+    for t in range(4):
+        for k, v in r.share_dict(t).get(3, {}).items():
+            share[k] = share.get(k, 0.0) + v
+    assert noshare == GOLD_NOSHARE_128
+    assert share == GOLD_SHARE_128

@@ -1,0 +1,140 @@
+"""Oracle self-checks against hand-derived golden histograms.
+
+The GEMM-128 expectations below were derived analytically from the loop
+structure (independent of both the oracle code and the reference):
+
+Per (c0,c1) body = C0,C1,(A0,B0,C2,C3)x128 = 514 accesses; each thread serves
+32 c0 values (8 chunks of 4); stream positions depend on thread-local rank only.
+
+- C line (c0*16 + c1/8): C0 reuse 1 (112/c0), C1 reuse 1 (128/c0),
+  C2 reuse 3 (16384/c0), C3 reuse 1 (16384/c0), cold 16/c0.
+- A line (c0*16 + c2/8): reuse 4 for k%8!=0 (14336/c0), reuse 486 -> bin 256
+  for k%8==0 at c1>0 (2032/c0), cold 16/c0.
+- B line (c2*16 + c1/8): reuse 514 -> bin 512 for c1%8!=0 (14336/c0);
+  c1%8==0 reuses cross a whole c1 loop: 62194 = 65792-7*514, share
+  (2*62194 > 16513), 2048 per c0 for thread-local rank>0; 2048 cold lines
+  per thread at rank 0.
+
+Totals (4 threads x 32 c0): noshare {-1:12288, 1:2127872, 2:2097152,
+4:1835008, 256:260096, 512:1835008}, share {62194:253952}, and
+12288 + sum(emits) = 8421376 accesses ("max iteration traversed",
+gemm_sampler.rs:305).
+"""
+
+import math
+
+import pytest
+
+from pluss.config import SamplerConfig
+from pluss.models import gemm
+from tests.oracle import (
+    OracleSampler,
+    aet_mrc,
+    cri_distribute,
+    cri_nbd,
+    merge_noshare,
+    merge_share,
+    mrc_dedup_lines,
+    nbd_pmf,
+    to_highest_power_of_two,
+)
+
+GOLD_NOSHARE_128 = {
+    -1: 12288.0,
+    1: 2127872.0,
+    2: 2097152.0,
+    4: 1835008.0,
+    256: 260096.0,
+    512: 1835008.0,
+}
+GOLD_SHARE_128 = {62194: 253952.0}
+
+
+def test_power_of_two_binning():
+    assert [to_highest_power_of_two(x) for x in (1, 2, 3, 4, 5, 7, 8, 513, 514)] == [
+        1, 2, 2, 4, 4, 4, 8, 512, 512,
+    ]
+
+
+@pytest.mark.slow
+def test_gemm128_golden_histograms():
+    o = OracleSampler(gemm(128)).run()
+    assert o.max_iteration_count == 8421376
+    assert merge_noshare(o.noshare) == GOLD_NOSHARE_128
+    assert merge_share(o.share) == GOLD_SHARE_128
+    # per-thread symmetry: every thread sees identical histograms
+    for t in range(1, 4):
+        assert o.noshare[t] == o.noshare[0]
+        assert dict(o.share[t]) == dict(o.share[0])
+
+
+def test_gemm8_counts():
+    o = OracleSampler(gemm(8)).run()
+    assert o.max_iteration_count == 8 * 8 * (2 + 4 * 8)
+    # trip 8, chunk 4 -> 2 chunks -> threads 2,3 idle
+    assert o.count[2] == 0 and o.count[3] == 0
+    # N=8: every row is one cache line; C/A cold 4 lines per active thread, B 8
+    assert o.noshare[0][-1] == 16.0
+    assert merge_noshare(o.noshare)[-1] == 32.0
+    assert merge_share(o.share) == {}
+
+
+def test_gemm8_small_lines_produce_share():
+    # CLS=DS makes every element its own line; B0 cross-c0 reuses become share
+    cfg = SamplerConfig(cls=8)
+    o = OracleSampler(gemm(8), cfg).run()
+    share = merge_share(o.share)
+    assert share, "expected share reuses with 1-element lines"
+    span = 73  # (8+1)*8+1
+    assert all(2 * r > span for r in share)
+
+
+def test_nbd_pmf_matches_reference_parameterization():
+    # NB(r=2, p=0.25): pmf(0) = 0.0625, pmf(1) = 2*0.25^2*0.75 = 0.09375
+    assert math.isclose(nbd_pmf(0, 2.0, 0.25), 0.0625)
+    assert math.isclose(nbd_pmf(1, 2.0, 0.25), 0.09375)
+    assert math.isclose(nbd_pmf(2, 2.0, 0.25), 3 * 0.25**2 * 0.75**2)
+
+
+def test_nbd_cutoff_point_mass():
+    dist = {}
+    cri_nbd(4, 3000, dist)  # 3000 >= 4000*3/4
+    assert dist == {12000: 1.0}
+    dist = {}
+    cri_nbd(4, 2999, dist)
+    assert len(dist) > 100  # a real dilation, not a point mass
+    assert math.isclose(sum(dist.values()), 1.0, abs_tol=2e-4)
+    assert min(dist) == 2999  # dist keys are k + n
+    # mean of NB(r=n,p=1/4) is n(1-p)/p = 3n -> mass centered near 4n
+    mean = sum(k * v for k, v in dist.items())
+    assert abs(mean - 4 * 2999) < 100
+
+
+def test_racetrack_residual_overwrite_semantics():
+    # share {n=3: {10: 1.0}}, T=4 -> NBD dilates 10, each dilated ri split into
+    # log2 bins; the last bin is OVERWRITten by 1-prob_sum (pluss_utils.h:1088)
+    rihist = cri_distribute([{}], [{3: {10: 1.0}}], 4)
+    assert all(k >= 0 for k in rihist)
+    # mass for one dilated ri: 1 - prob_old_last != 1; total stays within (0, 1.2]
+    total = sum(rihist.values())
+    assert 0.5 < total < 1.2
+
+
+def test_cri_noshare_mass_conserved():
+    rihist = cri_distribute([{4: 100.0, -1: 7.0}], [{}], 4)
+    assert rihist[-1] == 7.0
+    positive = sum(v for k, v in rihist.items() if k >= 0)
+    assert math.isclose(positive, 100.0, rel_tol=3e-4)
+    assert min(k for k in rihist if k > 0) >= 4
+
+
+def test_aet_mrc_monotone_and_bounded():
+    rihist = {-1: 10.0, 1: 50.0, 4: 30.0, 64: 10.0}
+    mrc = aet_mrc(rihist, cache_entries=327680)
+    assert mrc[0] == 1.0
+    vals = [mrc[c] for c in sorted(mrc)]
+    assert all(0.0 <= v <= 1.0 for v in vals)
+    assert all(a >= b - 1e-12 for a, b in zip(vals, vals[1:]))
+    lines = mrc_dedup_lines(mrc)
+    assert lines[0][0] == 0
+    assert len(lines) <= len(mrc)

@@ -1,0 +1,108 @@
+"""ChunkSchedule closed-form math vs a direct simulation of the reference's
+stateful ChunkDispatcher (pluss_utils.h:287-618, chunk_dispatcher.rs)."""
+
+import pytest
+
+from pluss.sched import ChunkSchedule
+
+
+class DispatcherSim:
+    """Literal re-enactment of the reference dispatcher's static protocol
+    (new_with_para + has_next_static_chunk + get_next_static_chunk,
+    chunk_dispatcher.rs:116-214)."""
+
+    def __init__(self, chunk_size, trip, start=0, step=1, thread_num=4):
+        self.cs, self.trip, self.start, self.step, self.T = (
+            chunk_size, trip, start, step, thread_num,
+        )
+        self.last = start + (trip - 1) * step
+        self.ptsp = [start + chunk_size * step * t for t in range(thread_num)]
+
+    def has_next(self, tid):
+        return self.ptsp[tid] <= self.last if self.step > 0 else self.ptsp[tid] >= self.last
+
+    def next_chunk(self, tid):
+        if self.step > 0:
+            lb = self.ptsp[tid]
+            ub = min(lb + (self.cs - 1) * self.step, self.last)
+        else:
+            ub = self.ptsp[tid]
+            lb = max(ub + (self.cs - 1) * self.step, self.last)
+        self.ptsp[tid] += self.cs * self.T * self.step
+        return lb, ub
+
+
+CASES = [
+    (4, 128, 0, 1, 4),   # the GEMM-128 live configuration
+    (4, 130, 0, 1, 4),   # partial last chunk
+    (3, 7, 0, 1, 4),     # fewer chunks than threads x rounds
+    (5, 23, 2, 1, 4),    # nonzero start
+    (4, 16, 0, 2, 4),    # stride 2
+    (7, 7, 0, 1, 2),     # single chunk
+    (4, 3, 0, 1, 4),     # trip < chunk_size
+    (2, 64, 0, 1, 8),    # 8 simulated threads
+]
+
+
+@pytest.mark.parametrize("cs,trip,start,step,T", CASES)
+def test_chunks_match_dispatcher_protocol(cs, trip, start, step, T):
+    s = ChunkSchedule(cs, trip, start, step, T)
+    sim = DispatcherSim(cs, trip, start, step, T)
+    for tid in range(T):
+        got = [s.chunk_bounds(cid) for cid in s.chunks_of_thread(tid)]
+        ref = []
+        while sim.has_next(tid):
+            ref.append(sim.next_chunk(tid))
+        assert got == ref, (tid, got, ref)
+
+
+@pytest.mark.parametrize("cs,trip,start,step,T", CASES)
+def test_thread_iterations_partition_the_loop(cs, trip, start, step, T):
+    s = ChunkSchedule(cs, trip, start, step, T)
+    seen = []
+    for tid in range(T):
+        vals = s.thread_iteration_values(tid)
+        assert vals == sorted(vals)
+        seen.extend(vals)
+    expect = [start + i * step for i in range(trip)]
+    assert sorted(seen) == sorted(expect)
+
+
+@pytest.mark.parametrize("cs,trip,start,step,T", CASES)
+def test_static_decomposition_formulas(cs, trip, start, step, T):
+    s = ChunkSchedule(cs, trip, start, step, T)
+    for tid in range(T):
+        for rank, idx in enumerate(s.thread_iteration_indices(tid)):
+            v = start + idx * step
+            assert s.static_tid(v) == tid
+            assert s.local_rank(v) == rank
+            assert s.static_thread_local_pos(v) == idx % cs
+
+
+@pytest.mark.parametrize("cs,trip,start,step,T", CASES)
+def test_engine_grid_formulas_match(cs, trip, start, step, T):
+    from pluss.sched import iteration_value_grid
+
+    s = ChunkSchedule(cs, trip, start, step, T)
+    for tid in range(T):
+        flat_valid = []
+        for row in iteration_value_grid(s, tid):
+            for g, v, rank, valid in row:
+                if valid:
+                    flat_valid.append((v, rank))
+        vals = s.thread_iteration_values(tid)
+        assert [v for v, _ in flat_valid] == vals
+        assert [r for _, r in flat_valid] == list(range(len(vals)))
+
+
+def test_dynamic_round_robin_equals_static():
+    s = ChunkSchedule(4, 128, 0, 1, 4)
+    assert s.dynamic_assignment() == [s.chunk_owner(c) for c in range(s.n_chunks)]
+
+
+def test_resume_start_point():
+    s = ChunkSchedule(4, 128, 0, 1, 4)
+    # resuming at iteration 37: round = 37//(4*4) = 2; every thread skips 2 rounds
+    for tid in range(4):
+        got = s.chunks_of_thread_from(tid, 37)
+        assert got == [c for c in s.chunks_of_thread(tid) if c >= 2 * 4]

@@ -1,0 +1,76 @@
+"""Spec flattening: positions/addresses of flattened refs must equal a direct
+in-order interpretation of the loop tree."""
+
+import pytest
+
+from pluss.models import REGISTRY, gemm
+from pluss.spec import FlatRef, Loop, Ref, flatten_nest, loop_size, nest_iteration_size, share_span_formula
+
+
+def interpret(nest: Loop):
+    """Walk the tree in program order, yielding (ref, ivs values) per access."""
+    out = []
+
+    def walk(item, ivs):
+        if isinstance(item, Ref):
+            out.append((item, tuple(ivs)))
+            return
+        for i in range(item.trip):
+            v = item.start + i * item.step
+            for b in item.body:
+                walk(b, ivs + [v])
+
+    walk(nest, [])
+    return out
+
+
+def flat_positions(nest: Loop):
+    """Evaluate every FlatRef's affine (pos, addr) over its full index grid."""
+    import itertools
+
+    entries = {}
+    for fr in flatten_nest(nest):
+        for idxs in itertools.product(*(range(t) for t in fr.trips)):
+            pos = fr.offset + sum(i * s for i, s in zip(idxs, fr.pos_strides))
+            ivs = tuple(st + i * sp for st, i, sp in zip(fr.starts, idxs, fr.steps))
+            addr = fr.ref.addr_base + sum(c * v for c, v in zip(fr.addr_coefs, ivs))
+            entries[pos] = (fr.ref.name, ivs[: len(fr.trips)], addr)
+    return entries
+
+
+@pytest.mark.parametrize("name", list(REGISTRY))
+def test_flatten_matches_interpretation(name):
+    spec = REGISTRY[name](8 if name != "stencil3d" else 6)
+    for nest in spec.nests:
+        seq = interpret(nest)
+        assert len(seq) == loop_size(nest)
+        flat = flat_positions(nest)
+        assert len(flat) == len(seq)
+        for pos, (ref, ivs) in enumerate(seq):
+            fname, fivs, faddr = flat[pos]
+            assert fname == ref.name
+            addr = ref.addr_base + sum(
+                c * ivs[d] for d, c in ref.addr_terms
+            )
+            assert faddr == addr, (pos, ref.name)
+
+
+def test_gemm_shapes_and_span():
+    spec = gemm(128)
+    nest = spec.nests[0]
+    assert nest_iteration_size(nest) == 65792          # 128*(2+4*128)
+    assert loop_size(nest) == 8421376                  # SURVEY.md §3.2 total
+    b0 = [fr for fr in flatten_nest(nest) if fr.ref.name == "B0"][0]
+    assert b0.ref.share_span == 16513                  # …omp.cpp:202
+    assert b0.pos_strides == (65792, 514, 4)
+    assert b0.offset == 3
+    assert share_span_formula(128) == 16513
+
+
+def test_gemm_addresses_match_reference_get_addr():
+    # get_addr (gemm_sampler.rs:34-38): line index = (i*128 + j) * DS / CLS
+    spec = gemm(128)
+    flat = {fr.ref.name: fr for fr in flatten_nest(spec.nests[0])}
+    assert flat["C0"].addr_coefs == (128, 1)
+    assert flat["A0"].addr_coefs == (128, 0, 1)
+    assert flat["B0"].addr_coefs == (0, 1, 128)
