@@ -9,10 +9,20 @@ here every occurrence of every static reference is materialized by broadcasted
 - stream position  ``pos  = nest_base + rank*stride0 + sum(idx_l*stride_l) + offset``
 - element address  ``addr = base + sum(coef_l * iv_l)`` -> cache line ``addr*DS//CLS``
 
-The simulated-thread dimension is a pure ``vmap`` axis: per-thread state is
-disjoint by construction in the reference (SURVEY.md §2 "execution parallelism"),
-so threads need no interaction until the histogram merge, which is an integer
-add (and a ``psum`` across devices, see :mod:`pluss.parallel`).
+The stream is processed in **round windows** under a ``lax.scan`` carrying a
+dense ``last_pos[line]`` table and the histogram, so arbitrarily long streams
+(GEMM-1024's 4.3e9 accesses, BASELINE.json config 2) run in bounded memory;
+small workloads compile to a single window.  The simulated-thread dimension is
+a pure ``vmap`` axis: per-thread state is disjoint by construction in the
+reference (SURVEY.md §2 "execution parallelism"), so threads need no
+interaction until the histogram merge (a ``psum`` across devices in
+:mod:`pluss.parallel`).
+
+Chunk->thread assignment is data, not control flow: a per-thread matrix of
+owned chunk ids drives the enumeration, which uniformly expresses the
+reference's static round-robin schedule (``pluss_utils.h:410-425``), its
+C++-only dynamic FIFO schedule (``pluss_utils.h:393-408``), and the
+``setStartPoint`` resume capability (``pluss_utils.h:443-472``).
 
 Results are *dense*: a [T, NBINS] no-share histogram (slot 0 = the cold key -1,
 slot 1+e = log2 key 2^e) and fixed-capacity raw (value, count) share pairs per
@@ -29,122 +39,248 @@ import jax.numpy as jnp
 import numpy as np
 
 from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
-from pluss.ops.reuse import LINE_SENTINEL, noshare_histogram, reuse_events, share_unique
+from pluss.ops.reuse import (
+    event_histogram,
+    share_unique,
+    sort_stream,
+    window_events,
+)
 from pluss.sched import ChunkSchedule
 from pluss.spec import FlatRef, LoopNestSpec, flatten_nest, nest_iteration_size
 
+#: default accesses per scan window (per simulated thread); streams shorter
+#: than this compile to a single window with no scan overhead.
+WINDOW_TARGET = 1 << 23
+
 
 @dataclasses.dataclass(frozen=True)
+class NestPlan:
+    sched: ChunkSchedule
+    refs: tuple[FlatRef, ...]
+    body: int                 # accesses per parallel iteration
+    owned: np.ndarray         # [T, NW*W] global chunk ids, -1 = none
+    window_rounds: int        # W
+    n_windows: int            # NW
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
 class StreamPlan:
-    """Static (trace-time) description of one workload's per-thread stream."""
+    """Static (trace-time) description of one workload's per-thread stream.
+
+    Identity-based hash/eq: plans hold ndarrays and are cached per
+    (spec, cfg, ...) key by :func:`compiled` already.
+    """
 
     spec: LoopNestSpec
     cfg: SamplerConfig
-    # per nest: (schedule, flat refs, padded length per thread)
-    nests: tuple[tuple[ChunkSchedule, tuple[FlatRef, ...], int], ...]
+    nests: tuple[NestPlan, ...]
     iters_per_thread: np.ndarray      # [n_nests, T] true parallel iterations
     nest_base: np.ndarray             # [n_nests, T] clock offset of each nest
-    padded_len: int                   # per-thread padded stream length
     total_count: int                  # true total accesses over all threads
+    pos_dtype: np.dtype               # stream-position dtype (int32 | int64)
 
 
-def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT) -> StreamPlan:
+def _owned_matrix(sched: ChunkSchedule, T: int,
+                  assignment: tuple[int, ...] | None,
+                  start_point: int | None) -> np.ndarray:
+    """[T, R] matrix of global chunk ids each thread serves, -1 padded.
+
+    Encodes static round-robin, explicit (dynamic-FIFO) assignment, and the
+    ``setStartPoint`` resume rule — every thread skips ``start_round`` full
+    rounds (pluss_utils.h:443-472).
+    """
+    if assignment is None:
+        assignment = tuple(c % T for c in range(sched.n_chunks))
+    elif len(assignment) != sched.n_chunks:
+        raise ValueError(
+            f"assignment covers {len(assignment)} chunks, schedule has "
+            f"{sched.n_chunks}"
+        )
+    skip = 0
+    if start_point is not None:
+        skip = sched.static_chunk_id(start_point) * T
+    per_thread: list[list[int]] = [[] for _ in range(T)]
+    for cid, tid in enumerate(assignment):
+        if cid < skip:
+            continue
+        if not 0 <= tid < T:
+            raise ValueError(f"assignment[{cid}]={tid} out of range")
+        per_thread[tid].append(cid)
+    # ascending per-thread lists guarantee the closed-form clock
+    # (rank = round*CS + pos) is gapless: the only partial chunk is the
+    # globally-last one, which then terminates its owner's stream
+    for lst in per_thread:
+        if lst != sorted(lst):
+            raise ValueError("per-thread chunk lists must be ascending "
+                             "(FIFO grant order)")
+    R = max((len(l) for l in per_thread), default=0)
+    out = np.full((T, max(R, 1)), -1, np.int32)
+    for t, lst in enumerate(per_thread):
+        out[t, : len(lst)] = lst
+    return out
+
+
+def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
+         assignment: tuple[tuple[int, ...] | None, ...] | None = None,
+         start_point: int | None = None,
+         window_accesses: int | None = None,
+         n_windows: int | None = None) -> StreamPlan:
+    """Build the static stream plan.
+
+    ``assignment``: optional per-nest chunk->thread maps (dynamic scheduling);
+    ``start_point``: resume iteration value applied to the first nest;
+    ``window_accesses``: scan-window size override (default WINDOW_TARGET);
+    ``n_windows``: force exactly this many equal round windows per nest (the
+    sharded backend maps one window per device).
+    """
     T = cfg.thread_num
-    nests = []
+    target = window_accesses or WINDOW_TARGET
+    nests: list[NestPlan] = []
     iters = np.zeros((len(spec.nests), T), np.int64)
     for ni, nest in enumerate(spec.nests):
         sched = ChunkSchedule(cfg.chunk_size, nest.trip, nest.start, nest.step, T)
         refs = tuple(flatten_nest(nest))
         body = nest_iteration_size(nest)
-        padded = sched.max_rounds() * cfg.chunk_size * body
-        nests.append((sched, refs, padded))
+        asg = assignment[ni] if assignment is not None else None
+        sp = start_point if ni == 0 else None
+        owned = _owned_matrix(sched, T, asg, sp)
+        R = owned.shape[1]
+        if n_windows is not None:
+            NW = n_windows
+            W = -(-R // NW)
+        else:
+            W = max(1, min(R, -(-target // (cfg.chunk_size * body))))
+            NW = -(-R // W)
+        pad = np.full((T, NW * W - R), -1, np.int32)
+        owned = np.concatenate([owned, pad], axis=1)
+        nests.append(NestPlan(sched, refs, body, owned, W, NW))
         for t in range(T):
-            iters[ni, t] = len(sched.thread_iteration_indices(t))
-    body_sizes = np.array(
-        [nest_iteration_size(n) for n in spec.nests], np.int64
-    )
+            for cid in owned[t]:
+                if cid >= 0:
+                    b, e = sched.chunk_index_range(int(cid))
+                    iters[ni, t] += e - b
+    body_sizes = np.array([n.body for n in nests], np.int64)
     nest_base = np.zeros_like(iters)
     nest_base[1:] = np.cumsum(iters[:-1] * body_sizes[:-1, None], axis=0)
-    padded_len = sum(p for _, _, p in nests)
     total = int((iters * body_sizes[:, None]).sum())
+    # padded per-thread clock bound (with margin) picks the position dtype
+    max_clock = int(
+        sum(n.n_windows * n.window_rounds * cfg.chunk_size * n.body for n in nests)
+    )
+    pos_dtype = np.dtype(np.int32) if max_clock < 2**30 else np.dtype(np.int64)
+    if pos_dtype == np.int64 and not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            f"stream of {max_clock} accesses/thread needs int64 positions; "
+            "enable jax_enable_x64"
+        )
     return StreamPlan(
         spec=spec,
         cfg=cfg,
         nests=tuple(nests),
         iters_per_thread=iters,
         nest_base=nest_base,
-        padded_len=padded_len,
         total_count=total,
+        pos_dtype=pos_dtype,
     )
 
 
-def _ref_stream(fr: FlatRef, sched: ChunkSchedule, cfg: SamplerConfig,
-                tid, nest_base, line_base: int):
-    """(line, pos, span, valid) flat arrays for all occurrences of one ref."""
-    T, CS = cfg.thread_num, cfg.chunk_size
-    R = sched.max_rounds()
-    shape = (R, CS) + fr.trips[1:]
-    ndim = len(shape)
+def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
+                owned_row, r0, nest_base, line_base: int, pos_dtype):
+    """(line, pos, span, valid) flat arrays for one ref over rounds [r0, r0+W)."""
+    CS = cfg.chunk_size
+    sched = np_.sched
+    shape = (np_.window_rounds, CS) + fr.trips[1:]
 
     def iota(axis):
         return jax.lax.broadcasted_iota(jnp.int32, shape, axis)
 
     r, p = iota(0), iota(1)
-    g = (r * T + tid) * CS + p
-    valid = g < sched.trip
-    rank = r * CS + p
+    cid = owned_row[r0 + r]
+    g = cid * CS + p
+    valid = (cid >= 0) & (g < sched.trip)
+    rank = (r0 + r).astype(pos_dtype) * CS + p
 
     pos = nest_base + rank * fr.pos_strides[0] + fr.offset
     addr = fr.ref.addr_base + fr.addr_coefs[0] * (sched.start + g * sched.step)
     for l in range(1, len(fr.trips)):
         idx = iota(l + 1)
-        pos = pos + idx * fr.pos_strides[l]
+        pos = pos + idx.astype(pos_dtype) * fr.pos_strides[l]
         if fr.addr_coefs[l]:
             addr = addr + fr.addr_coefs[l] * (fr.starts[l] + idx * fr.steps[l])
     line = line_base + addr * cfg.ds // cfg.cls
     span = jnp.full(shape, fr.ref.share_span or 0, jnp.int32)
     return (
-        jnp.where(valid, line, LINE_SENTINEL).reshape(-1).astype(jnp.int32),
-        pos.reshape(-1).astype(jnp.int32),
+        line.reshape(-1).astype(jnp.int32),
+        pos.reshape(-1).astype(pos_dtype),
         span.reshape(-1),
         valid.reshape(-1),
     )
 
 
 def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
-    """Full per-thread pipeline: enumerate -> sort -> histogram.  vmapped on tid."""
+    """Full per-thread pipeline: scan windows -> sort -> histogram.  vmapped."""
     cfg = pl.cfg
     bases = pl.spec.line_bases(cfg)
-    lines, poss, spans, valids = [], [], [], []
-    nest_base = jnp.asarray(pl.nest_base, jnp.int32)
-    for ni, (sched, refs, _) in enumerate(pl.nests):
-        for fr in refs:
-            l, p, s, v = _ref_stream(
-                fr, sched, cfg, tid, nest_base[ni, tid],
-                bases[pl.spec.array_index(fr.ref.array)],
-            )
-            lines.append(l); poss.append(p); spans.append(s); valids.append(v)
-    line = jnp.concatenate(lines)
-    pos = jnp.concatenate(poss)
-    span = jnp.concatenate(spans)
-    valid = jnp.concatenate(valids)
-    ev = reuse_events(line, pos, span, valid)
-    hist = noshare_histogram(ev)
-    svals, scnts, snu = share_unique(ev, share_cap)
-    return hist, svals, scnts, snu
+    n_lines = pl.spec.total_lines(cfg)
+    pdt = jnp.dtype(pl.pos_dtype)
+    last_pos = jnp.full((n_lines,), -1, pdt)
+    hist = jnp.zeros((NBINS,), pdt)
+    nest_base = jnp.asarray(pl.nest_base.astype(pl.pos_dtype))
+    share_ys = []
+    for ni, np_ in enumerate(pl.nests):
+        owned_row = jnp.asarray(np_.owned)[tid]
+        nb = nest_base[ni, tid]
+
+        def step(carry, r0, np_=np_, owned_row=owned_row, nb=nb):
+            last_pos, hist = carry
+            parts = [
+                _ref_window(
+                    fr, np_, cfg, owned_row, r0, nb,
+                    bases[pl.spec.array_index(fr.ref.array)], pdt,
+                )
+                for fr in np_.refs
+            ]
+            line = jnp.concatenate([p[0] for p in parts])
+            pos = jnp.concatenate([p[1] for p in parts])
+            span = jnp.concatenate([p[2] for p in parts])
+            valid = jnp.concatenate([p[3] for p in parts])
+            ev, last_pos = window_events(*sort_stream(line, pos, span, valid),
+                                         last_pos)
+            hist = hist + event_histogram(ev)
+            sv, sc, snu = share_unique(ev, share_cap)
+            return (last_pos, hist), (sv, sc, snu)
+
+        r0s = jnp.arange(np_.n_windows, dtype=jnp.int32) * np_.window_rounds
+        if np_.n_windows == 1:
+            (last_pos, hist), ys = step((last_pos, hist), r0s[0])
+            ys = jax.tree.map(lambda a: a[None], ys)
+        else:
+            (last_pos, hist), ys = jax.lax.scan(step, (last_pos, hist), r0s)
+        share_ys.append(ys)
+    return hist, share_ys
 
 
 @functools.lru_cache(maxsize=None)
-def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int):
+def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
+             assignment=None, start_point=None, window_accesses=None,
+             backend: str = "vmap"):
     """(plan, jitted fn) for a workload; cached so repeat runs reuse the XLA
     executable (the reference's `speed` mode re-runs the same sampler 3x,
     main.rs:23-35)."""
-    pl = plan(spec, cfg)
+    pl = plan(spec, cfg, assignment, start_point, window_accesses)
 
-    def f(tids):
-        return jax.vmap(lambda t: _thread_pipeline(t, pl, share_cap))(tids)
+    if backend == "vmap":
+        def f(tids):
+            return jax.vmap(lambda t: _thread_pipeline(t, pl, share_cap))(tids)
+        return pl, jax.jit(f)
+    if backend == "seq":
+        one = jax.jit(lambda t: _thread_pipeline(t, pl, share_cap))
 
-    return pl, jax.jit(f)
+        def f(tids):
+            outs = [one(t) for t in tids]
+            return jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
+        return pl, f
+    raise ValueError(f"unknown backend {backend!r} (expected 'vmap' or 'seq')")
 
 
 @dataclasses.dataclass
@@ -158,8 +294,7 @@ class SamplerResult:
     """
 
     noshare_dense: np.ndarray   # [T, NBINS] int64
-    share_vals: np.ndarray      # [T, CAP] int32
-    share_cnts: np.ndarray      # [T, CAP] int64
+    share_raw: list[dict]       # [T] {raw reuse: count}
     share_ratio: int
     max_iteration_count: int
 
@@ -179,11 +314,7 @@ class SamplerResult:
         return out
 
     def share_dict(self, tid: int) -> dict:
-        h = {
-            int(v): float(c)
-            for v, c in zip(self.share_vals[tid], self.share_cnts[tid])
-            if c
-        }
+        h = {int(v): float(c) for v, c in self.share_raw[tid].items()}
         return {self.share_ratio: h} if h else {}
 
     def noshare_list(self) -> list[dict]:
@@ -193,22 +324,53 @@ class SamplerResult:
         return [self.share_dict(t) for t in range(self.thread_num)]
 
 
+def merge_share_windows(svals, scnts, snu, share_cap: int,
+                        thread_num: int) -> list[dict]:
+    """Host-side merge of per-(thread, window) share uniques into raw dicts."""
+    out: list[dict] = [dict() for _ in range(thread_num)]
+    for ni in range(len(svals)):
+        sv = np.asarray(svals[ni])
+        sc = np.asarray(scnts[ni])
+        nu = np.asarray(snu[ni])
+        if (nu > share_cap).any():
+            raise ValueError(
+                f"share-value capacity exceeded: {int(nu.max())} uniques > cap "
+                f"{share_cap}; re-run with a larger share_cap"
+            )
+        for t in range(thread_num):
+            vals, cnts = sv[t].reshape(-1, sv.shape[-1]), sc[t].reshape(-1, sc.shape[-1])
+            nz = cnts > 0
+            d = out[t]
+            for v, c in zip(vals[nz].tolist(), cnts[nz].tolist()):
+                d[v] = d.get(v, 0) + c
+    return out
+
+
 def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
-        share_cap: int = SHARE_CAP) -> SamplerResult:
-    """Run the sampler on the default backend (vmap over simulated threads)."""
-    pl, f = compiled(spec, cfg, share_cap)
-    tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
-    hist, svals, scnts, snu = f(tids)
-    snu = np.asarray(snu)
-    if (snu > share_cap).any():
-        raise ValueError(
-            f"share-value capacity exceeded: {int(snu.max())} uniques > cap "
-            f"{share_cap}; re-run with a larger share_cap"
+        share_cap: int = SHARE_CAP, assignment=None, start_point=None,
+        window_accesses=None, backend: str = "vmap") -> SamplerResult:
+    """Run the sampler.
+
+    ``backend``: 'vmap' (default — simulated threads as a vmap axis) or 'seq'
+    (one thread at a time), mirroring the reference's backend trio; the
+    device-sharded backend lives in :mod:`pluss.parallel`.
+    """
+    if assignment is not None:
+        assignment = tuple(
+            tuple(a) if a is not None else None for a in assignment
         )
+    pl, f = compiled(spec, cfg, share_cap, assignment, start_point,
+                     window_accesses, backend)
+    tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
+    hist, share_ys = f(tids)
+    # share_ys: per nest (svals [T, NW, cap], scnts, snu [T, NW])
+    share_raw = merge_share_windows(
+        [y[0] for y in share_ys], [y[1] for y in share_ys],
+        [y[2] for y in share_ys], share_cap, cfg.thread_num,
+    )
     return SamplerResult(
         noshare_dense=np.asarray(hist, np.int64),
-        share_vals=np.asarray(svals),
-        share_cnts=np.asarray(scnts, np.int64),
+        share_raw=share_raw,
         share_ratio=cfg.thread_num - 1,
         max_iteration_count=pl.total_count,
     )

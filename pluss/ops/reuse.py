@@ -7,16 +7,16 @@ LAT[addr]``, store, tick).  That is an inherently sequential O(stream) pointer
 chase — the worst possible shape for a TPU.
 
 Key observation: the reuse interval of an access is just the gap to the
-*previous position of the same cache line*.  Sorting the stream by
+*previous position of the same cache line*.  Sorting a window of the stream by
 ``(line, position)`` places every line's accesses consecutively in position
-order, so one vectorized subtraction yields every reuse interval at once, and
-first-touches (= the reference's end-of-run cold flush, ``gemm_sampler.rs:48-53``)
-are exactly the sort-segment heads.  No carried state, fully parallel, and the
-same code path serves generated affine streams and raw replayed traces.
-
-All arrays are int32: per-thread stream positions are < 2^31 (a 2-billion-access
-walk per simulated thread) and lexicographic two-key ``lax.sort`` avoids the
-packed-int64 keys a single-key sort would need.
+order, so one vectorized subtraction yields every within-window reuse at once.
+Window *heads* (first local touch of a line) resolve against a carried dense
+``last_pos[line]`` table — either threaded through a ``lax.scan`` over windows
+(streaming single-device path, :mod:`pluss.engine`) or combined across devices
+with a gather + prefix-max (sharded path, :mod:`pluss.parallel`).  First global
+touches are exactly the heads with no carried entry (= the reference's
+end-of-run cold flush, ``gemm_sampler.rs:48-53``).  The same code path serves
+generated affine streams and raw replayed traces.
 """
 
 from __future__ import annotations
@@ -36,63 +36,109 @@ def log2_bin(reuse: jnp.ndarray) -> jnp.ndarray:
     Matches ``_polybench_to_highest_power_of_two`` (utils.rs:119-132) which keeps
     only the top set bit; slot 0 is reserved for the cold key -1.
     """
-    e = 31 - jax.lax.clz(jnp.maximum(reuse, 1).astype(jnp.int32))
+    bits = jnp.iinfo(reuse.dtype).bits
+    e = (bits - 1) - jax.lax.clz(jnp.maximum(reuse, 1))
     return (1 + e).astype(jnp.int32)
 
 
-def reuse_events(line: jnp.ndarray, pos: jnp.ndarray, span: jnp.ndarray,
-                 valid: jnp.ndarray):
-    """Compute reuse events of one thread's access stream.
+def sort_stream(line, pos, span, valid):
+    """Sort one stream window by (line, position); invalid entries sort last.
 
-    Args:
-      line:  [E] int32 global cache-line ids.
-      pos:   [E] int32 stream positions (the per-thread logical clock value of
-             each access; need not arrive in position order).
-      span:  [E] int32 share-test span of the access's static reference
-             (0 = the reference carries no cross-thread test).
-      valid: [E] bool, False for padding.
-
-    Returns dict of [E]-aligned (sorted order) arrays:
-      reuse:   int32 gap to previous same-line access (undefined where ~has_prev)
-      has_prev: bool — a reuse interval was observed
-      first:   bool — first touch of a line (contributes to the cold count)
-      share:   bool — reuse classified cross-thread by the reference's
-               ``distance_to(reuse,0) > distance_to(reuse,span)`` test, which for
-               integers is exactly ``2*reuse > span`` (gemm_sampler.rs:199).
+    Returns (key_s, pos_s, span_s, valid_s[int32]).
     """
     key = jnp.where(valid, line, LINE_SENTINEL)
-    key_s, pos_s, span_s, valid_s = jax.lax.sort(
-        (key, pos, span, valid.astype(jnp.int32)), num_keys=2
-    )
-    same = jnp.concatenate(
-        [jnp.zeros((1,), bool), key_s[1:] == key_s[:-1]]
-    )
-    prev_pos = jnp.concatenate([pos_s[:1], pos_s[:-1]])
-    valid_b = valid_s.astype(bool)
-    has_prev = same & valid_b
-    reuse = jnp.where(has_prev, pos_s - prev_pos, 0).astype(jnp.int32)
-    first = valid_b & ~same
-    share = has_prev & (span_s > 0) & (2 * reuse > span_s)
-    return {
-        "reuse": reuse,
-        "has_prev": has_prev,
-        "first": first,
-        "share": share,
-    }
+    return jax.lax.sort((key, pos, span, valid.astype(jnp.int32)), num_keys=2)
 
 
-def noshare_histogram(ev: dict) -> jnp.ndarray:
-    """[NBINS] int32 dense histogram: slot 0 = cold (-1), slot 1+e = key 2^e.
+def window_events(key_s, pos_s, span_s, valid_i, last_pos):
+    """Reuse events of one sorted window, resolved against carried state.
 
-    Cold weight = number of first touches = the LAT table sizes the reference
-    flushes at the end (gemm_sampler.rs:48-53); no-share reuses are binned at
-    insert (utils.rs:106-107, Q6).
+    Args:
+      key_s/pos_s/span_s/valid_i: outputs of :func:`sort_stream`.
+      last_pos: ``[n_lines]`` dense array of each line's most recent stream
+        position before this window, or -1 if never touched.  Pass ``None`` to
+        leave window heads unresolved (the sharded path combines them across
+        devices itself).
+
+    Returns ``(ev, new_last_pos)`` where ``ev`` is a dict of window-aligned
+    arrays:
+
+      reuse:  gap to the previous same-line access (in-window or carried)
+      is_evt: a reuse interval was observed
+      share:  reuse classified cross-thread by the reference's
+              ``distance_to(reuse,0) > distance_to(reuse,span)`` test — exactly
+              ``2*reuse > span`` for integers (gemm_sampler.rs:199)
+      cold:   first *global* touch of a line (contributes to the cold key -1)
+      head:   first in-window touch of a line
+      tail:   last in-window touch of a line
+
+    and ``new_last_pos`` is the carry advanced past this window (``None`` when
+    ``last_pos`` is ``None``).
     """
-    evt = ev["has_prev"] & ~ev["share"]
-    # reuse events land in their log2 slot (>=1); first-touches in the cold slot 0
+    valid_b = valid_i.astype(bool)
+    same = jnp.concatenate([jnp.zeros((1,), bool), key_s[1:] == key_s[:-1]])
+    prev_pos = jnp.concatenate([pos_s[:1], pos_s[:-1]])
+    local_evt = same & valid_b
+    head = valid_b & ~same
+    tail = valid_b & ~jnp.concatenate([key_s[1:] == key_s[:-1], jnp.zeros((1,), bool)])
+
+    if last_pos is not None:
+        n_lines = last_pos.shape[0]
+        safe_key = jnp.where(valid_b, key_s, 0)
+        carried = last_pos[safe_key]
+        head_evt = head & (carried >= 0)
+        cold = head & (carried < 0)
+        reuse = jnp.where(
+            local_evt, pos_s - prev_pos, jnp.where(head_evt, pos_s - carried, 0)
+        )
+        is_evt = local_evt | head_evt
+        tgt = jnp.where(tail, key_s, n_lines)
+        new_last_pos = last_pos.at[tgt].set(pos_s, mode="drop")
+    else:
+        cold = jnp.zeros_like(head)
+        reuse = jnp.where(local_evt, pos_s - prev_pos, 0)
+        is_evt = local_evt
+        new_last_pos = None
+
+    share = is_evt & (span_s > 0) & (2 * reuse > span_s)
+    return {
+        "reuse": reuse.astype(pos_s.dtype),
+        "is_evt": is_evt,
+        "share": share,
+        "cold": cold,
+        "head": head,
+        "tail": tail,
+    }, new_last_pos
+
+
+def event_histogram(ev: dict) -> jnp.ndarray:
+    """[NBINS] dense histogram of one window: slot 0 = cold (-1), slot 1+e = 2^e.
+
+    No-share reuses are binned at insert (utils.rs:106-107, SURVEY.md Q6);
+    share reuses are excluded (they stay raw until the racetrack post-pass).
+    """
+    evt = ev["is_evt"] & ~ev["share"]
     bins = jnp.where(evt, log2_bin(ev["reuse"]), 0)
-    w = jnp.where(ev["first"] | evt, 1, 0).astype(jnp.int32)
+    w = (ev["cold"] | evt).astype(ev["reuse"].dtype)
     return jax.ops.segment_sum(w, bins, num_segments=NBINS)
+
+
+def boundary_arrays(key_s, pos_s, span_s, ev: dict, n_lines: int):
+    """Dense per-line (head_pos, head_span, tail_pos) of one sorted window.
+
+    Heads/tails are unique per line, so plain scatters suffice.  Untouched
+    lines hold -1.  The sharded backend gathers these across devices to resolve
+    cross-shard reuses (:mod:`pluss.parallel`).
+    """
+    head_t = jnp.where(ev["head"], key_s, n_lines)
+    tail_t = jnp.where(ev["tail"], key_s, n_lines)
+    init = jnp.full((n_lines,), -1, pos_s.dtype)
+    head_pos = init.at[head_t].set(pos_s, mode="drop")
+    head_span = jnp.full((n_lines,), 0, span_s.dtype).at[head_t].set(
+        span_s, mode="drop"
+    )
+    tail_pos = init.at[tail_t].set(pos_s, mode="drop")
+    return head_pos, head_span, tail_pos
 
 
 def share_unique(ev: dict, cap: int):
@@ -103,19 +149,20 @@ def share_unique(ev: dict, cap: int):
     events are sorted; segment boundaries give the unique values and a
     segment-sum the counts.
 
-    Returns (vals [cap] int32, counts [cap] int32, n_unique int32).  If
-    ``n_unique > cap`` the trailing uniques were dropped; callers must check.
+    Returns (vals [cap], counts [cap], n_unique int32).  If ``n_unique > cap``
+    the trailing uniques were dropped; callers must check.
     """
-    sv = jnp.where(ev["share"], ev["reuse"], LINE_SENTINEL)
+    sent = jnp.iinfo(ev["reuse"].dtype).max
+    sv = jnp.where(ev["share"], ev["reuse"], sent)
     sv = jax.lax.sort(sv)
-    is_evt = sv != LINE_SENTINEL
+    is_evt = sv != sent
     boundary = jnp.concatenate([is_evt[:1], (sv[1:] != sv[:-1]) & is_evt[1:]])
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     seg = jnp.where(is_evt, seg, cap)  # padding -> overflow slot
     counts = jax.ops.segment_sum(
         is_evt.astype(jnp.int32), seg, num_segments=cap + 1
     )[:cap]
-    vals = jnp.zeros((cap + 1,), jnp.int32).at[seg].set(
+    vals = jnp.zeros((cap + 1,), sv.dtype).at[seg].set(
         jnp.where(is_evt, sv, 0), mode="drop"
     )[:cap]
     n_unique = boundary.sum().astype(jnp.int32)

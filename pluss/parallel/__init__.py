@@ -1,0 +1,11 @@
+"""Device-parallel execution: stream-sharded sampling over a ``jax.sharding.Mesh``.
+
+TPU-native replacement for the reference's shared-memory fan-outs (OpenMP /
+Rayon / std::thread, SURVEY.md §2): windows of the simulated-thread streams are
+sharded over devices with ``shard_map``, boundary state is exchanged with one
+``all_gather``, and histograms merge with ``psum`` over ICI (DCN across hosts).
+"""
+
+from pluss.parallel.shard import default_mesh, shard_run
+
+__all__ = ["default_mesh", "shard_run"]

@@ -1,0 +1,179 @@
+"""Device-sharded sampling: round windows over a mesh, histograms over ICI.
+
+The reference's only cross-worker interaction is a post-hoc sum of per-thread
+histograms (``/root/reference/src/utils.rs:154-176,310-322``; its "backends"
+are OpenMP / Rayon / std::thread fan-outs of the same walk, SURVEY.md §2).
+Here the scalable axis is different and strictly stronger: the **stream**
+dimension is sharded.  Each simulated thread's access stream is cut into one
+round-window per device (the same windows the single-device engine scans);
+every device sorts its window locally, and the only cross-device state is a
+dense per-line boundary exchange:
+
+- each device emits ``tail_pos[line]`` (last local position) per segment;
+- an ``all_gather`` + masked max over earlier segments yields each segment's
+  ``prev_last[line]`` — the carried LAT table the scan path threads serially;
+- window heads resolve against it (reuse, share, or cold);
+- histograms merge with ``psum`` over ICI, exactly the reference's
+  all-reduce-by-summation (SURVEY.md §2 "communication backend").
+
+Segments are ordered ``(nest, device)``: all devices' windows of nest 0
+precede nest 1's, matching the global clock.  This is the moral equivalent of
+ring/blockwise sequence parallelism for long streams — small carried state,
+local heavy compute, one boundary collective — and it runs unchanged on a
+multi-host mesh (DCN collectives) because only ``all_gather``/``psum`` are
+used.  No point-to-point communication is ever needed (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
+from pluss.engine import SamplerResult, StreamPlan, _ref_window, plan
+from pluss.ops.reuse import (
+    boundary_arrays,
+    event_histogram,
+    log2_bin,
+    share_unique,
+    sort_stream,
+    window_events,
+)
+from pluss.spec import LoopNestSpec
+
+
+def default_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` (default: all) local devices."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.asarray(devs[:n]), ("d",))
+
+
+def _device_segments(tid, pl: StreamPlan, share_cap: int, d):
+    """One device's segments (one window per nest) for one simulated thread.
+
+    Returns per-nest stacked local results plus dense boundary arrays.
+    """
+    cfg = pl.cfg
+    bases = pl.spec.line_bases(cfg)
+    n_lines = pl.spec.total_lines(cfg)
+    pdt = jnp.dtype(pl.pos_dtype)
+    nest_base = jnp.asarray(pl.nest_base.astype(pl.pos_dtype))
+    hists, svs, scs, snus, hps, hss, tps = [], [], [], [], [], [], []
+    for ni, np_ in enumerate(pl.nests):
+        owned_row = jnp.asarray(np_.owned)[tid]
+        r0 = d * np_.window_rounds
+        parts = [
+            _ref_window(
+                fr, np_, cfg, owned_row, r0, nest_base[ni, tid],
+                bases[pl.spec.array_index(fr.ref.array)], pdt,
+            )
+            for fr in np_.refs
+        ]
+        line = jnp.concatenate([p[0] for p in parts])
+        pos = jnp.concatenate([p[1] for p in parts])
+        span = jnp.concatenate([p[2] for p in parts])
+        valid = jnp.concatenate([p[3] for p in parts])
+        key_s, pos_s, span_s, valid_i = sort_stream(line, pos, span, valid)
+        ev, _ = window_events(key_s, pos_s, span_s, valid_i, None)
+        hists.append(event_histogram(ev))
+        sv, sc, snu = share_unique(ev, share_cap)
+        svs.append(sv); scs.append(sc); snus.append(snu)
+        hp, hs, tp = boundary_arrays(key_s, pos_s, span_s, ev, n_lines)
+        hps.append(hp); hss.append(hs); tps.append(tp)
+    stack = lambda xs: jnp.stack(xs)
+    return (stack(hists), stack(svs), stack(scs), stack(snus),
+            stack(hps), stack(hss), stack(tps))
+
+
+def _shard_body(tids, pl: StreamPlan, share_cap: int, D: int):
+    d = jax.lax.axis_index("d")
+    N = len(pl.nests)
+    (hist, sv, sc, snu, head_pos, head_span, tail_pos) = jax.vmap(
+        lambda t: _device_segments(t, pl, share_cap, d)
+    )(tids)
+    # tail exchange: [D, T, N, L] — the only cross-device state
+    tails_all = jax.lax.all_gather(tail_pos, "d")
+    ni_idx = jnp.arange(N)
+    dev_idx = jnp.arange(D)
+    prevs = []
+    for ni in range(N):
+        # segments (nj, e) strictly before (ni, d) in global clock order
+        earlier = (ni_idx[None, :] < ni) | (
+            (ni_idx[None, :] == ni) & (dev_idx[:, None] < d)
+        )
+        m = earlier[:, None, :, None]  # [D, 1, N, 1]
+        prevs.append(jnp.max(jnp.where(m, tails_all, -1), axis=(0, 2)))
+    prev = jnp.stack(prevs, axis=1)  # [T, N, L]
+
+    has_head = head_pos >= 0
+    head_evt = has_head & (prev >= 0)
+    cold = has_head & (prev < 0)
+    reuse = jnp.where(head_evt, head_pos - prev, 0)
+    share = head_evt & (head_span > 0) & (2 * reuse > head_span)
+    nevt = head_evt & ~share
+    bins = jnp.where(nevt, log2_bin(reuse), 0)
+    w = (cold | nevt).astype(hist.dtype)
+    head_hist = jax.vmap(
+        lambda bb, ww: jax.ops.segment_sum(ww.ravel(), bb.ravel(),
+                                           num_segments=NBINS)
+    )(bins, w)
+    total = hist.sum(axis=1) + head_hist            # [T, NBINS]
+    total = jax.lax.psum(total, "d")                # replicated merge over ICI
+    head_share = jnp.where(share, reuse, -1)        # [T, N, L] raw values
+    return total, sv[None], sc[None], snu[None], head_share[None]
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
+              mesh: Mesh):
+    D = mesh.devices.size
+    pl = plan(spec, cfg, n_windows=D)
+    f = jax.shard_map(
+        lambda t: _shard_body(t, pl, share_cap, D),
+        mesh=mesh,
+        in_specs=P(),
+        out_specs=(P(), P("d"), P("d"), P("d"), P("d")),
+    )
+    return pl, jax.jit(f)
+
+
+def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
+              share_cap: int = SHARE_CAP,
+              mesh: Mesh | None = None) -> SamplerResult:
+    """Run the sampler with stream windows sharded over a device mesh."""
+    mesh = mesh or default_mesh()
+    pl, f = _compiled(spec, cfg, share_cap, mesh)
+    tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
+    hist, sv, sc, snu, head_share = f(tids)
+    sv, sc, snu = np.asarray(sv), np.asarray(sc), np.asarray(snu)
+    if (snu > share_cap).any():
+        raise ValueError(
+            f"share-value capacity exceeded: {int(snu.max())} uniques > cap "
+            f"{share_cap}; re-run with a larger share_cap"
+        )
+    T = cfg.thread_num
+    share_raw: list[dict] = [dict() for _ in range(T)]
+    for dev in range(sv.shape[0]):
+        for t in range(T):
+            for ni in range(sv.shape[2]):
+                vals, cnts = sv[dev, t, ni], sc[dev, t, ni]
+                nz = cnts > 0
+                dd = share_raw[t]
+                for v, c in zip(vals[nz].tolist(), cnts[nz].tolist()):
+                    dd[v] = dd.get(v, 0) + c
+    hv = np.asarray(head_share)
+    for dev in range(hv.shape[0]):
+        for t in range(T):
+            for v in hv[dev, t][hv[dev, t] >= 0].tolist():
+                share_raw[t][v] = share_raw[t].get(v, 0) + 1
+    return SamplerResult(
+        noshare_dense=np.asarray(hist, np.int64),
+        share_raw=share_raw,
+        share_ratio=T - 1,
+        max_iteration_count=pl.total_count,
+    )

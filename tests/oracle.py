@@ -87,16 +87,35 @@ class OracleSampler:
                 for b in item.body:
                     self._walk_dispatch(tid, b, ivs + [v])
 
-    def run(self):
+    def run(self, assignment=None, start_point=None):
+        """Walk the spec.  ``assignment``/``start_point`` re-enact the
+        reference's dynamic-FIFO scheduling and setStartPoint resume
+        *independently of the engine*: chunk ownership is derived here from
+        the stateless :class:`ChunkSchedule` API alone."""
         cfg = self.cfg
-        for nest in self.spec.nests:
+        for ni, nest in enumerate(self.spec.nests):
             sched = ChunkSchedule(
                 cfg.chunk_size, nest.trip, nest.start, nest.step, cfg.thread_num
             )
             for tid in range(cfg.thread_num):
-                for v in sched.thread_iteration_values(tid):
-                    for b in nest.body:
-                        self._walk_dispatch(tid, b, [v])
+                if assignment is not None and assignment[ni] is not None:
+                    chunks = [
+                        c for c, t in enumerate(sched.dynamic_assignment(
+                            list(assignment[ni]))) if t == tid
+                    ]
+                else:
+                    chunks = sched.chunks_of_thread(tid)
+                if ni == 0 and start_point is not None:
+                    # setStartPoint (pluss_utils.h:443-472): every thread
+                    # skips the rounds before the start point's chunk round
+                    skip = sched.static_chunk_id(start_point) * cfg.thread_num
+                    chunks = [c for c in chunks if c >= skip]
+                for cid in chunks:
+                    b0, e0 = sched.chunk_index_range(cid)
+                    for i in range(b0, e0):
+                        v = sched.start + i * sched.step
+                        for b in nest.body:
+                            self._walk_dispatch(tid, b, [v])
         # cold flush, array-declaration order (gemm_sampler.rs:280-282)
         for name, _ in self.spec.arrays:
             for tid in range(cfg.thread_num):

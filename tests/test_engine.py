@@ -9,9 +9,11 @@ from pluss.models import REGISTRY, gemm
 from tests.oracle import OracleSampler, merge_noshare, merge_share
 
 
-def assert_matches_oracle(spec, cfg):
-    o = OracleSampler(spec, cfg).run()
-    r = run(spec, cfg)
+def assert_matches_oracle(spec, cfg, **kw):
+    o = OracleSampler(spec, cfg).run(
+        assignment=kw.get("assignment"), start_point=kw.get("start_point")
+    )
+    r = run(spec, cfg, **kw)
     assert r.max_iteration_count == o.max_iteration_count
     for t in range(cfg.thread_num):
         assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
@@ -46,6 +48,51 @@ def test_other_kernels_match_oracle(name):
 
 def test_stencil3d_matches_oracle():
     assert_matches_oracle(REGISTRY["stencil3d"](8), SamplerConfig(cls=8))
+
+
+def test_windowed_scan_matches_single_window():
+    # tiny windows force a many-step lax.scan with dense last_pos carry;
+    # results must be identical to the single-window compile
+    cfg = SamplerConfig(cls=8)
+    full = run(gemm(16), cfg)
+    win = run(gemm(16), cfg, window_accesses=512)
+    assert win.noshare_dense.tolist() == full.noshare_dense.tolist()
+    assert win.share_raw == full.share_raw
+
+
+def test_seq_backend_matches_vmap():
+    cfg = SamplerConfig(cls=8)
+    a = run(gemm(12), cfg)
+    b = run(gemm(12), cfg, backend="seq")
+    assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
+    assert a.share_raw == b.share_raw
+
+
+def test_dynamic_assignment_matches_oracle():
+    # FIFO grant order where thread (c+1)%T asks first each round: a cyclic
+    # shift of the static map — the C++-only dynamic dispatcher capability
+    # (pluss_utils.h:393-408)
+    cfg = SamplerConfig(cls=8)
+    spec = gemm(16)
+    from pluss.sched import ChunkSchedule
+
+    sched = ChunkSchedule(cfg.chunk_size, 16, 0, 1, cfg.thread_num)
+    asg = tuple((c + 1) % cfg.thread_num for c in range(sched.n_chunks))
+    assert_matches_oracle(spec, cfg, assignment=(asg,))
+
+
+def test_start_point_resume_matches_oracle():
+    # setStartPoint semantics (pluss_utils.h:443-472): every thread skips the
+    # rounds before the start point's chunk round
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(gemm(16), cfg, start_point=8)
+
+
+def test_multi_nest_windowed_matches_oracle():
+    from pluss.models import REGISTRY
+
+    assert_matches_oracle(REGISTRY["2mm"](8), SamplerConfig(cls=8),
+                          window_accesses=256)
 
 
 @pytest.mark.slow
