@@ -106,13 +106,10 @@ def _owned_matrix(sched: ChunkSchedule, T: int,
         if not 0 <= tid < T:
             raise ValueError(f"assignment[{cid}]={tid} out of range")
         per_thread[tid].append(cid)
-    # ascending per-thread lists guarantee the closed-form clock
-    # (rank = round*CS + pos) is gapless: the only partial chunk is the
-    # globally-last one, which then terminates its owner's stream
-    for lst in per_thread:
-        if lst != sorted(lst):
-            raise ValueError("per-thread chunk lists must be ascending "
-                             "(FIFO grant order)")
+    # per-thread lists are ascending by construction (cid enumerates upward),
+    # which guarantees the closed-form clock (rank = round*CS + pos) is
+    # gapless: the only partial chunk is the globally-last one, which then
+    # terminates its owner's stream
     R = max((len(l) for l in per_thread), default=0)
     out = np.full((T, max(R, 1)), -1, np.int32)
     for t, lst in enumerate(per_thread):
@@ -217,6 +214,23 @@ def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
     )
 
 
+def window_stream(np_: NestPlan, cfg: SamplerConfig, owned_row, r0, nest_base,
+                  bases, array_index, pdt):
+    """Sorted (key, pos, span, valid) stream of one nest window — the shared
+    enumeration step of the scan path and the device-sharded path."""
+    parts = [
+        _ref_window(fr, np_, cfg, owned_row, r0, nest_base,
+                    bases[array_index(fr.ref.array)], pdt)
+        for fr in np_.refs
+    ]
+    return sort_stream(
+        jnp.concatenate([p[0] for p in parts]),
+        jnp.concatenate([p[1] for p in parts]),
+        jnp.concatenate([p[2] for p in parts]),
+        jnp.concatenate([p[3] for p in parts]),
+    )
+
+
 def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
     """Full per-thread pipeline: scan windows -> sort -> histogram.  vmapped."""
     cfg = pl.cfg
@@ -233,19 +247,9 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
 
         def step(carry, r0, np_=np_, owned_row=owned_row, nb=nb):
             last_pos, hist = carry
-            parts = [
-                _ref_window(
-                    fr, np_, cfg, owned_row, r0, nb,
-                    bases[pl.spec.array_index(fr.ref.array)], pdt,
-                )
-                for fr in np_.refs
-            ]
-            line = jnp.concatenate([p[0] for p in parts])
-            pos = jnp.concatenate([p[1] for p in parts])
-            span = jnp.concatenate([p[2] for p in parts])
-            valid = jnp.concatenate([p[3] for p in parts])
-            ev, last_pos = window_events(*sort_stream(line, pos, span, valid),
-                                         last_pos)
+            stream = window_stream(np_, cfg, owned_row, r0, nb, bases,
+                                   pl.spec.array_index, pdt)
+            ev, last_pos = window_events(*stream, last_pos)
             hist = hist + event_histogram(ev)
             sv, sc, snu = share_unique(ev, share_cap)
             return (last_pos, hist), (sv, sc, snu)

@@ -34,13 +34,18 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
-from pluss.engine import SamplerResult, StreamPlan, _ref_window, plan
+from pluss.engine import (
+    SamplerResult,
+    StreamPlan,
+    merge_share_windows,
+    plan,
+    window_stream,
+)
 from pluss.ops.reuse import (
     boundary_arrays,
     event_histogram,
     log2_bin,
     share_unique,
-    sort_stream,
     window_events,
 )
 from pluss.spec import LoopNestSpec
@@ -50,6 +55,8 @@ def default_mesh(n_devices: int | None = None) -> Mesh:
     """1-D mesh over the first ``n_devices`` (default: all) local devices."""
     devs = jax.devices()
     n = n_devices or len(devs)
+    if len(devs) < n:
+        raise ValueError(f"requested {n} devices, only {len(devs)} visible")
     return Mesh(np.asarray(devs[:n]), ("d",))
 
 
@@ -67,18 +74,10 @@ def _device_segments(tid, pl: StreamPlan, share_cap: int, d):
     for ni, np_ in enumerate(pl.nests):
         owned_row = jnp.asarray(np_.owned)[tid]
         r0 = d * np_.window_rounds
-        parts = [
-            _ref_window(
-                fr, np_, cfg, owned_row, r0, nest_base[ni, tid],
-                bases[pl.spec.array_index(fr.ref.array)], pdt,
-            )
-            for fr in np_.refs
-        ]
-        line = jnp.concatenate([p[0] for p in parts])
-        pos = jnp.concatenate([p[1] for p in parts])
-        span = jnp.concatenate([p[2] for p in parts])
-        valid = jnp.concatenate([p[3] for p in parts])
-        key_s, pos_s, span_s, valid_i = sort_stream(line, pos, span, valid)
+        key_s, pos_s, span_s, valid_i = window_stream(
+            np_, cfg, owned_row, r0, nest_base[ni, tid], bases,
+            pl.spec.array_index, pdt,
+        )
         ev, _ = window_events(key_s, pos_s, span_s, valid_i, None)
         hists.append(event_histogram(ev))
         sv, sc, snu = share_unique(ev, share_cap)
@@ -150,22 +149,16 @@ def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     pl, f = _compiled(spec, cfg, share_cap, mesh)
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
     hist, sv, sc, snu, head_share = f(tids)
+    # [D, T, N, ...] -> per-nest [T, D, ...] for the shared window merge
     sv, sc, snu = np.asarray(sv), np.asarray(sc), np.asarray(snu)
-    if (snu > share_cap).any():
-        raise ValueError(
-            f"share-value capacity exceeded: {int(snu.max())} uniques > cap "
-            f"{share_cap}; re-run with a larger share_cap"
-        )
     T = cfg.thread_num
-    share_raw: list[dict] = [dict() for _ in range(T)]
-    for dev in range(sv.shape[0]):
-        for t in range(T):
-            for ni in range(sv.shape[2]):
-                vals, cnts = sv[dev, t, ni], sc[dev, t, ni]
-                nz = cnts > 0
-                dd = share_raw[t]
-                for v, c in zip(vals[nz].tolist(), cnts[nz].tolist()):
-                    dd[v] = dd.get(v, 0) + c
+    N = sv.shape[2]
+    share_raw = merge_share_windows(
+        [sv[:, :, ni].transpose(1, 0, 2) for ni in range(N)],
+        [sc[:, :, ni].transpose(1, 0, 2) for ni in range(N)],
+        [snu[:, :, ni].transpose(1, 0) for ni in range(N)],
+        share_cap, T,
+    )
     hv = np.asarray(head_share)
     for dev in range(hv.shape[0]):
         for t in range(T):
