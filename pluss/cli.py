@@ -1,0 +1,117 @@
+"""`acc` / `speed` CLI — the reference's differential-test driver, TPU-native.
+
+Mirrors the reference's entry points (``/root/reference/src/main.rs:12-37``,
+``c_lib/test/sampler/…omp.cpp:334-362``, ``run.sh``):
+
+- ``acc``: run each backend once; print a full block (timing banner, the three
+  histogram dumps, "max iteration traversed") per backend.  Unlike the
+  reference's Rust binary, global state is fresh per backend run (SURVEY.md Q1
+  is a bug we fix, not a behavior we keep), so every block is directly
+  comparable — the reference's C++ binaries behave this way too (fresh process
+  per run).
+- ``speed``: N timed reps per backend (reference: 3), banner+seconds each.
+
+Backends mirror the reference's trio (rayon / spawn / seq) as:
+``vmap`` (simulated threads as a vmap axis), ``shard`` (stream windows over the
+device mesh, :mod:`pluss.parallel.shard`), ``seq`` (one thread at a time).
+
+Extra subcommand ``mrc`` exposes the reference's dormant titular capability
+(AET -> miss-ratio curve, pluss_utils.h:758-804) as a live, tested path.
+
+The timed region matches the reference: ``sampler() + pluss_cri_distribute``
+(…omp.cpp:337-339).  Compilation is excluded by a warmup call — the analogue of
+the reference timing a prebuilt binary, documented here because the reference's
+C++ flushes the data cache before timing instead (pluss.cpp:71-81); a TPU
+executable cache plays the role of the built binary, not the data cache.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from pluss import cri, engine, mrc
+from pluss.config import SHARE_CAP, SamplerConfig
+from pluss.io import acc_block, speed_block
+from pluss.models import REGISTRY
+
+BACKENDS = ("vmap", "shard", "seq")
+
+
+def _run_backend(backend: str, spec, cfg: SamplerConfig, share_cap: int):
+    """One timed (sampler + distribute) run; returns (seconds, result, rihist)."""
+    if backend == "shard":
+        from pluss.parallel.shard import default_mesh, shard_run
+
+        mesh = default_mesh()
+        shard_run(spec, cfg, share_cap, mesh)  # warmup/compile
+        t0 = time.perf_counter()
+        res = shard_run(spec, cfg, share_cap, mesh)
+        ri = cri.distribute(res.noshare_list(), res.share_list(), cfg.thread_num)
+        dt = time.perf_counter() - t0
+    else:
+        engine.run(spec, cfg, share_cap, backend=backend)  # warmup/compile
+        t0 = time.perf_counter()
+        res = engine.run(spec, cfg, share_cap, backend=backend)
+        ri = cri.distribute(res.noshare_list(), res.share_list(), cfg.thread_num)
+        dt = time.perf_counter() - t0
+    return dt, res, ri
+
+
+def banner_of(backend: str) -> str:
+    return {"vmap": "TPU VMAP", "shard": "TPU SHARD", "seq": "TPU SEQ"}[backend]
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="pluss", description=__doc__)
+    p.add_argument("mode", choices=("acc", "speed", "mrc"))
+    p.add_argument("--model", default="gemm", choices=sorted(REGISTRY))
+    p.add_argument("--n", type=int, default=128, help="problem size")
+    p.add_argument("--backends", default="vmap,shard,seq",
+                   help="comma list of " + ",".join(BACKENDS))
+    p.add_argument("--threads", type=int, default=4, help="simulated threads")
+    p.add_argument("--chunk", type=int, default=4, help="schedule chunk size")
+    p.add_argument("--reps", type=int, default=3, help="speed-mode repetitions")
+    p.add_argument("--share-cap", type=int, default=SHARE_CAP)
+    p.add_argument("--out", default="mrc.csv", help="mrc-mode output file")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the host CPU backend (8 virtual devices)")
+    args = p.parse_args(argv)
+
+    if args.cpu:
+        from pluss.utils.platform import force_cpu
+
+        force_cpu(8)
+
+    spec = REGISTRY[args.model](args.n)
+    cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk)
+    backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    for b in backends:
+        if b not in BACKENDS:
+            p.error(f"unknown backend {b!r}")
+
+    out = sys.stdout
+    if args.mode == "acc":
+        for b in backends:
+            dt, res, ri = _run_backend(b, spec, cfg, args.share_cap)
+            acc_block(banner_of(b), dt, res.noshare_list(), res.share_list(),
+                      ri, res.max_iteration_count, out)
+    elif args.mode == "speed":
+        for b in backends:
+            times = [
+                _run_backend(b, spec, cfg, args.share_cap)[0]
+                for _ in range(args.reps)
+            ]
+            speed_block(banner_of(b), times, out)
+    else:  # mrc
+        _, res, ri = _run_backend(backends[0], spec, cfg, args.share_cap)
+        curve = mrc.aet_mrc(ri, cfg)
+        mrc.write_mrc(args.out, curve)
+        out.write(f"wrote {len(mrc.dedup_lines(curve))} MRC lines to "
+                  f"{args.out} (curve over {len(curve)} cache sizes)\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Output formatting with reference parity.
+
+Reproduces the C++ runtime's dump format exactly (the canonical golden blocks of
+the reference's differential `acc` test, SURVEY.md §4):
+
+- ``_pluss_histogram_print`` (``/root/reference/c_lib/test/runtime/
+  pluss_utils.h:690-702``): title line, then one ``key,count,count/sum`` line
+  per key in ascending key order (the C++ sorts through a ``std::map``; the
+  reference's Rust port prints HashMap order and is nondeterministic —
+  SURVEY.md Q5, we follow the C++).
+- Doubles print like ``std::cout`` defaults (6 significant digits, scientific
+  past 1e6) — Python's ``%g`` is the same algorithm.
+- Timing banner ``<NAME>: <seconds>`` with ``%0.6f`` seconds
+  (``pluss.cpp:105-107``).
+- The `acc` block tail ``max iteration traversed\\n<count>\\n\\n``
+  (``…omp.cpp:345-348``).
+"""
+
+from __future__ import annotations
+
+from typing import IO, Iterable
+
+from pluss.cri import Histogram, merge
+
+#: dump titles, byte-identical to the reference's
+NOSHARE_TITLE = "Start to dump noshare private reuse time"
+SHARE_TITLE = "Start to dump share private reuse time"
+RI_TITLE = "Start to dump reuse time"
+PRI_TITLE = "Start to dump private reuse time"
+
+
+def fmt_double(v: float) -> str:
+    """``std::cout << double`` default formatting (6 significant digits)."""
+    return f"{v:g}"
+
+
+def histogram_lines(title: str, hist: Histogram) -> Iterable[str]:
+    total = sum(hist.values())
+    yield title
+    for k in sorted(hist):
+        v = hist[k]
+        yield f"{k},{fmt_double(v)},{fmt_double(v / total if total else 0.0)}"
+
+
+def print_histogram(title: str, hist: Histogram, out: IO[str]) -> None:
+    for line in histogram_lines(title, hist):
+        out.write(line + "\n")
+
+
+def merge_noshare(noshare: list[Histogram]) -> Histogram:
+    """Per-thread no-share merge for printing: keys are already log2-binned at
+    insert, so the merge does NOT re-bin (``in_log_format=false`` in
+    ``pluss_cri_noshare_print_histogram``, pluss_utils.h:938-948)."""
+    return merge(noshare)
+
+
+def merge_share(share: list[Histogram]) -> Histogram:
+    """Per-thread share merge for printing: raw (unbinned) reuse keys, summed
+    across the share-ratio groups (pluss_utils.h:949-960)."""
+    out: Histogram = {}
+    for per_thread in share:
+        for group in per_thread.values():
+            for k, v in group.items():
+                out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def acc_block(banner: str, seconds: float, noshare: list[Histogram],
+              share: list[Histogram], rihist: Histogram,
+              max_iteration_count: int, out: IO[str]) -> None:
+    """One full `acc` output block in the C++ main's order (…omp.cpp:337-348)."""
+    out.write(f"{banner}: {seconds:0.6f}\n")
+    print_histogram(NOSHARE_TITLE, merge_noshare(noshare), out)
+    print_histogram(SHARE_TITLE, merge_share(share), out)
+    print_histogram(RI_TITLE, rihist, out)
+    out.write("max iteration traversed\n")
+    out.write(f"{max_iteration_count}\n")
+    out.write("\n")
+
+
+def speed_block(banner: str, seconds_per_rep: list[float], out: IO[str]) -> None:
+    """One `speed` output block: a banner+time line per rep (…omp.cpp:350-358)."""
+    for s in seconds_per_rep:
+        out.write(f"{banner}: {s:0.6f}\n")
+    out.write("\n")

@@ -23,11 +23,14 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pluss.config import NBINS
 
-#: sentinel line id that sorts after every real line (padding & non-events)
-LINE_SENTINEL = jnp.int32(2**31 - 1)
+#: sentinel line id that sorts after every real line (padding & non-events).
+#: numpy scalar, NOT a jnp array: creating a device array at import time would
+#: initialize the default (tunneled-TPU) backend before callers can pin CPU.
+LINE_SENTINEL = np.int32(2**31 - 1)
 
 
 def log2_bin(reuse: jnp.ndarray) -> jnp.ndarray:

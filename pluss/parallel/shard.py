@@ -149,15 +149,13 @@ def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     pl, f = _compiled(spec, cfg, share_cap, mesh)
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
     hist, sv, sc, snu, head_share = f(tids)
-    # [D, T, N, ...] -> per-nest [T, D, ...] for the shared window merge
+    # [D, T, N, ...] -> [T, D, N, ...]: merge_share_windows flattens every
+    # non-thread axis anyway, so one transpose covers all nests at once
     sv, sc, snu = np.asarray(sv), np.asarray(sc), np.asarray(snu)
     T = cfg.thread_num
-    N = sv.shape[2]
     share_raw = merge_share_windows(
-        [sv[:, :, ni].transpose(1, 0, 2) for ni in range(N)],
-        [sc[:, :, ni].transpose(1, 0, 2) for ni in range(N)],
-        [snu[:, :, ni].transpose(1, 0) for ni in range(N)],
-        share_cap, T,
+        [sv.transpose(1, 0, 2, 3)], [sc.transpose(1, 0, 2, 3)],
+        [snu.transpose(1, 0, 2)], share_cap, T,
     )
     hv = np.asarray(head_share)
     for dev in range(hv.shape[0]):

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Differential acc|speed driver, mirroring the reference's run.sh
+# (/root/reference/run.sh): run the native C++ baseline first (if built), then
+# the TPU backends, all appending blocks to output.txt for side-by-side diffing.
+set -e
+METHOD=${1:-acc}
+
+if [ -f pluss/cpp/build/pluss_cpp ]; then
+  ./pluss/cpp/build/pluss_cpp "$METHOD" >> output.txt
+elif [ -d pluss/cpp ]; then
+  (cd pluss/cpp && make -s) && ./pluss/cpp/build/pluss_cpp "$METHOD" >> output.txt
+fi
+
+python -m pluss.cli "$METHOD" >> output.txt

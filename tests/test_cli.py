@@ -1,0 +1,95 @@
+"""CLI + printer parity: block format, determinism, cross-backend agreement."""
+
+import io as _io
+import re
+
+import pytest
+
+from pluss import cli, cri, engine
+from pluss.io import (
+    NOSHARE_TITLE,
+    RI_TITLE,
+    SHARE_TITLE,
+    acc_block,
+    fmt_double,
+    histogram_lines,
+    merge_noshare,
+    merge_share,
+)
+from pluss.models import gemm
+
+
+def test_fmt_double_matches_cout_defaults():
+    # std::cout << double prints 6 significant digits, scientific past ~1e6
+    assert fmt_double(2127872.0) == "2.12787e+06"
+    assert fmt_double(12288.0) == "12288"
+    assert fmt_double(0.2527354) == "0.252735"
+    assert fmt_double(1.0) == "1"
+
+
+def test_histogram_lines_sorted_with_ratio():
+    lines = list(histogram_lines("T", {4: 1.0, -1: 2.0, 2: 1.0}))
+    assert lines[0] == "T"
+    assert lines[1].startswith("-1,2,0.5")
+    keys = [int(l.split(",")[0]) for l in lines[1:]]
+    assert keys == sorted(keys)
+
+
+@pytest.fixture(scope="module")
+def gemm16():
+    res = engine.run(gemm(16))
+    ri = cri.distribute(res.noshare_list(), res.share_list(), 4)
+    return res, ri
+
+
+def test_acc_block_format(gemm16):
+    res, ri = gemm16
+    buf = _io.StringIO()
+    acc_block("TPU VMAP", 0.1234567, res.noshare_list(), res.share_list(),
+              ri, res.max_iteration_count, buf)
+    lines = buf.getvalue().splitlines()
+    assert lines[0] == "TPU VMAP: 0.123457"
+    assert NOSHARE_TITLE in lines and SHARE_TITLE in lines and RI_TITLE in lines
+    assert lines[-3] == "max iteration traversed"
+    assert lines[-2] == str(res.max_iteration_count)
+    assert lines[-1] == ""
+    # every histogram line is key,count,ratio
+    for ln in lines[1:-3]:
+        if ln and not ln.startswith("Start to dump"):
+            assert re.fullmatch(r"-?\d+,[^,]+,[^,]+", ln), ln
+
+
+def test_acc_blocks_agree_across_backends(capsys):
+    cli.main(["acc", "--n", "16", "--backends", "vmap,seq"])
+    blocks = capsys.readouterr().out.strip().split("\n\n")
+    assert len(blocks) == 2
+    # strip the timing banner; everything else must be identical (the
+    # reference's differential acc criterion, SURVEY.md §4)
+    bodies = ["\n".join(b.splitlines()[1:]) for b in blocks]
+    assert bodies[0] == bodies[1]
+    assert "max iteration traversed" in bodies[0]
+
+
+def test_speed_mode_block(capsys):
+    cli.main(["speed", "--n", "16", "--backends", "vmap", "--reps", "2"])
+    out = capsys.readouterr().out
+    assert len(re.findall(r"^TPU VMAP: \d+\.\d{6}$", out, re.M)) == 2
+
+
+def test_mrc_mode(tmp_path, capsys):
+    out = tmp_path / "m.csv"
+    cli.main(["mrc", "--n", "16", "--backends", "vmap", "--out", str(out)])
+    text = out.read_text().splitlines()
+    assert text[0] == "miss ratio"
+    assert text[1].startswith("0, 1")
+
+
+def test_merge_share_raw_keys(gemm16):
+    res, _ = gemm16
+    m = merge_share(res.share_list())
+    assert all(k > 0 for k in m)  # raw reuse values, no -1, unbinned
+
+
+def test_merge_noshare_has_cold_key(gemm16):
+    res, _ = gemm16
+    assert -1 in merge_noshare(res.noshare_list())

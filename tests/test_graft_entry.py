@@ -1,7 +1,6 @@
 """The driver's contract: entry() jit-compiles, dryrun_multichip(8) passes."""
 
 import jax
-import pytest
 
 import __graft_entry__ as ge
 
@@ -17,6 +16,5 @@ def test_entry_compiles_and_runs():
 
 
 def test_dryrun_multichip():
-    if len(jax.devices()) < 8:
-        pytest.skip("needs the 8-device virtual CPU mesh")
+    # dryrun_multichip pins an 8-device virtual CPU mesh itself
     ge.dryrun_multichip(8)
