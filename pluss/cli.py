@@ -39,24 +39,30 @@ from pluss.models import REGISTRY
 BACKENDS = ("vmap", "shard", "seq")
 
 
-def _run_backend(backend: str, spec, cfg: SamplerConfig, share_cap: int):
-    """One timed (sampler + distribute) run; returns (seconds, result, rihist)."""
+def _sampler_of(backend: str, spec, cfg: SamplerConfig, share_cap: int):
+    """() -> (result, rihist) closure for one backend."""
     if backend == "shard":
         from pluss.parallel.shard import default_mesh, shard_run
 
         mesh = default_mesh()
-        shard_run(spec, cfg, share_cap, mesh)  # warmup/compile
-        t0 = time.perf_counter()
-        res = shard_run(spec, cfg, share_cap, mesh)
-        ri = cri.distribute(res.noshare_list(), res.share_list(), cfg.thread_num)
-        dt = time.perf_counter() - t0
+        run_once = lambda: shard_run(spec, cfg, share_cap, mesh)
     else:
-        engine.run(spec, cfg, share_cap, backend=backend)  # warmup/compile
-        t0 = time.perf_counter()
-        res = engine.run(spec, cfg, share_cap, backend=backend)
+        run_once = lambda: engine.run(spec, cfg, share_cap, backend=backend)
+
+    def step():
+        res = run_once()
         ri = cri.distribute(res.noshare_list(), res.share_list(), cfg.thread_num)
-        dt = time.perf_counter() - t0
-    return dt, res, ri
+        return res, ri
+
+    return step
+
+
+def _timed(step):
+    """Time one (sampler + distribute) step — the reference's timed region
+    (…omp.cpp:337-339)."""
+    t0 = time.perf_counter()
+    res, ri = step()
+    return time.perf_counter() - t0, res, ri
 
 
 def banner_of(backend: str) -> str:
@@ -94,18 +100,20 @@ def main(argv: list[str] | None = None) -> int:
     out = sys.stdout
     if args.mode == "acc":
         for b in backends:
-            dt, res, ri = _run_backend(b, spec, cfg, args.share_cap)
+            step = _sampler_of(b, spec, cfg, args.share_cap)
+            step()  # warmup: exclude compilation from the timed region
+            dt, res, ri = _timed(step)
             acc_block(banner_of(b), dt, res.noshare_list(), res.share_list(),
                       ri, res.max_iteration_count, out)
     elif args.mode == "speed":
         for b in backends:
-            times = [
-                _run_backend(b, spec, cfg, args.share_cap)[0]
-                for _ in range(args.reps)
-            ]
+            step = _sampler_of(b, spec, cfg, args.share_cap)
+            step()  # warmup once per backend
+            times = [_timed(step)[0] for _ in range(args.reps)]
             speed_block(banner_of(b), times, out)
     else:  # mrc
-        _, res, ri = _run_backend(backends[0], spec, cfg, args.share_cap)
+        step = _sampler_of(backends[0], spec, cfg, args.share_cap)
+        _, res, ri = _timed(step)
         curve = mrc.aet_mrc(ri, cfg)
         mrc.write_mrc(args.out, curve)
         out.write(f"wrote {len(mrc.dedup_lines(curve))} MRC lines to "

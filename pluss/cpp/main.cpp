@@ -1,0 +1,109 @@
+// Standalone acc|speed binary: the native baseline block of run.sh, mirroring
+// the reference's C++ mains (/root/reference/c_lib/test/sampler/…omp.cpp:
+// 334-362) — banner + %0.6f seconds, three sorted histogram dumps,
+// "max iteration traversed".  The GEMM spec is built here with the same
+// declarative tree the Python side marshals (pluss/models/gemm.py).
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "pluss_rt.hpp"
+
+using pluss::Histogram;
+
+namespace {
+
+pluss::Spec gemm_spec(long long n, int ds, int cls) {
+  using pluss::Loop;
+  using pluss::Node;
+  using pluss::Ref;
+  long long span = (n + 1) * n + 1;  // share threshold (…omp.cpp:202)
+  auto cref = [&](void) {
+    Node nd;
+    nd.is_ref = true;
+    nd.ref = Ref{0, 0, -1, {{0, n}, {1, 1}}};
+    return nd;
+  };
+  Node a0;
+  a0.is_ref = true;
+  a0.ref = Ref{1, 0, -1, {{0, n}, {2, 1}}};
+  Node b0;
+  b0.is_ref = true;
+  b0.ref = Ref{2, 0, span, {{2, n}, {1, 1}}};
+  auto inner = std::make_shared<Loop>();
+  inner->trip = n;
+  inner->body = {a0, b0, cref(), cref()};
+  Node inner_n;
+  inner_n.loop = inner;
+  auto mid = std::make_shared<Loop>();
+  mid->trip = n;
+  mid->body = {cref(), cref(), inner_n};
+  Node mid_n;
+  mid_n.loop = mid;
+  Loop nest;
+  nest.trip = n;
+  nest.body = {mid_n};
+  pluss::Spec spec;
+  spec.nests = {nest};
+  for (int a = 0; a < 3; ++a)
+    spec.array_lines.push_back((n * n * ds + cls - 1) / cls);
+  return spec;
+}
+
+void print_hist(const char* title, const Histogram& h) {
+  std::printf("%s\n", title);
+  double sum = 0.0;
+  for (auto& [k, v] : h) sum += v;
+  for (auto& [k, v] : h)
+    std::printf("%lld,%g,%g\n", k, v, sum != 0.0 ? v / sum : 0.0);
+}
+
+Histogram merge_noshare(const std::vector<Histogram>& per_thread) {
+  Histogram out;
+  for (auto& h : per_thread)
+    for (auto& [k, v] : h) out[k] += v;
+  return out;
+}
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string mode = argc > 1 ? argv[1] : "acc";
+  long long n = argc > 2 ? std::atoll(argv[2]) : 128;
+  pluss::Config cfg;
+  pluss::Spec spec = gemm_spec(n, cfg.ds, cfg.cls);
+
+  if (mode == "acc") {
+    double t0 = now_s();
+    pluss::SampleResult res = pluss::run_sampler(spec, cfg);
+    Histogram ri = pluss::cri_distribute(res, cfg);
+    std::printf("NATIVE C++: %0.6f\n", now_s() - t0);
+    print_hist("Start to dump noshare private reuse time",
+               merge_noshare(res.noshare));
+    print_hist("Start to dump share private reuse time",
+               merge_noshare(res.share));
+    print_hist("Start to dump reuse time", ri);
+    std::printf("max iteration traversed\n%lld\n\n", res.total_count);
+  } else if (mode == "speed") {
+    for (int rep = 0; rep < 3; ++rep) {
+      double t0 = now_s();
+      pluss::SampleResult res = pluss::run_sampler(spec, cfg);
+      Histogram ri = pluss::cri_distribute(res, cfg);
+      (void)ri;
+      std::printf("NATIVE C++: %0.6f\n", now_s() - t0);
+      if (res.total_count == 0) return 1;
+    }
+    std::printf("\n");
+  } else {
+    std::fprintf(stderr, "usage: %s {acc|speed} [n]\n", argv[0]);
+    return 2;
+  }
+  return 0;
+}

@@ -65,13 +65,29 @@ def merge_share(share: list[Histogram]) -> Histogram:
     return out
 
 
+def merge_pri(noshare: list[Histogram], share: list[Histogram]) -> Histogram:
+    """The C++-only private-reuse dump's merge: no-share (binned keys) plus
+    share (raw keys) in one histogram (``pluss_pri_print_histogram``,
+    pluss_utils.h:961-985 — dormant in the reference's mains, live here via
+    ``acc_block(..., with_pri=True)``)."""
+    out = merge_noshare(noshare)
+    for k, v in merge_share(share).items():
+        out[k] = out.get(k, 0.0) + v
+    return out
+
+
 def acc_block(banner: str, seconds: float, noshare: list[Histogram],
               share: list[Histogram], rihist: Histogram,
-              max_iteration_count: int, out: IO[str]) -> None:
-    """One full `acc` output block in the C++ main's order (…omp.cpp:337-348)."""
+              max_iteration_count: int, out: IO[str],
+              with_pri: bool = False) -> None:
+    """One full `acc` output block in the C++ main's order (…omp.cpp:337-348).
+
+    ``with_pri`` adds the C++-only merged private-reuse dump."""
     out.write(f"{banner}: {seconds:0.6f}\n")
     print_histogram(NOSHARE_TITLE, merge_noshare(noshare), out)
     print_histogram(SHARE_TITLE, merge_share(share), out)
+    if with_pri:
+        print_histogram(PRI_TITLE, merge_pri(noshare, share), out)
     print_histogram(RI_TITLE, rihist, out)
     out.write("max iteration traversed\n")
     out.write(f"{max_iteration_count}\n")

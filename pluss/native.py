@@ -1,0 +1,179 @@
+"""ctypes binding to the native C++ runtime (pluss/cpp).
+
+The native runtime is the framework's C++ component — the structural peer of
+the reference's C++ samplers + runtime header (``/root/reference/c_lib/test/``)
+— and serves as (a) the differential baseline block in ``run.sh`` and (b) the
+denominator for ``bench.py``'s speedup.  It interprets the same declarative
+:class:`~pluss.spec.LoopNestSpec` the XLA engine consumes, marshalled as a flat
+int64 token stream (grammar in ``pluss/cpp/pluss_rt.hpp``).
+
+The binding degrades gracefully: :func:`available` is False until
+``make -C pluss/cpp`` has produced ``build/libpluss_rt.so``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+from pluss.config import DEFAULT, SamplerConfig
+from pluss.spec import Loop, LoopNestSpec, Ref
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+CPP_DIR = os.path.join(_DIR, "cpp")
+LIB_PATH = os.path.join(CPP_DIR, "build", "libpluss_rt.so")
+BIN_PATH = os.path.join(CPP_DIR, "build", "pluss_cpp")
+
+_lib = None
+
+
+def build(quiet: bool = True) -> None:
+    """Build the native runtime in place (requires g++)."""
+    subprocess.run(
+        ["make", "-C", CPP_DIR] + (["-s"] if quiet else []),
+        check=True,
+        capture_output=quiet,
+    )
+
+
+def available(autobuild: bool = False) -> bool:
+    if os.path.exists(LIB_PATH):
+        return True
+    if autobuild:
+        try:
+            build()
+        except (OSError, subprocess.CalledProcessError):
+            return False
+        return os.path.exists(LIB_PATH)
+    return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(LIB_PATH)
+    i64p = ctypes.POINTER(ctypes.c_longlong)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    lib.pluss_run.restype = ctypes.c_void_p
+    lib.pluss_run.argtypes = [
+        i64p, ctypes.c_longlong, i64p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+    ]
+    lib.pluss_total_count.restype = ctypes.c_longlong
+    lib.pluss_total_count.argtypes = [ctypes.c_void_p]
+    for name in ("pluss_get_noshare", "pluss_get_share"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int, i64p, f64p,
+                       ctypes.c_longlong]
+    lib.pluss_get_ri.restype = ctypes.c_longlong
+    lib.pluss_get_ri.argtypes = [ctypes.c_void_p, i64p, f64p, ctypes.c_longlong]
+    lib.pluss_get_mrc.restype = ctypes.c_longlong
+    lib.pluss_get_mrc.argtypes = [ctypes.c_void_p, f64p, ctypes.c_longlong]
+    lib.pluss_destroy.restype = None
+    lib.pluss_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def spec_tokens(spec: LoopNestSpec) -> np.ndarray:
+    """Marshal a spec into the token grammar of ``pluss_rt.hpp``."""
+    toks: list[int] = [len(spec.nests)]
+
+    def emit(item) -> None:
+        if isinstance(item, Ref):
+            toks.extend([
+                1,
+                spec.array_index(item.array),
+                item.addr_base,
+                -1 if item.share_span is None else item.share_span,
+                len(item.addr_terms),
+            ])
+            for depth, coef in item.addr_terms:
+                toks.extend([depth, coef])
+        else:
+            toks.extend([0, item.trip, item.start, item.step, len(item.body)])
+            for b in item.body:
+                emit(b)
+
+    for nest in spec.nests:
+        emit(nest)
+    return np.asarray(toks, np.int64)
+
+
+class NativeResult:
+    """Mirror of :class:`pluss.engine.SamplerResult` + RI hist + MRC."""
+
+    def __init__(self, handle, lib, thread_num: int):
+        self._h = handle
+        self._lib = lib
+        self.thread_num = thread_num
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.pluss_destroy(self._h)
+            self._h = None
+
+    def _hist(self, getter, *pre) -> dict:
+        cap = 256
+        while True:
+            keys = np.empty(cap, np.int64)
+            vals = np.empty(cap, np.float64)
+            n = getter(
+                self._h, *pre,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), cap,
+            )
+            if n < 0:
+                raise ValueError("bad tid")
+            if n <= cap:
+                return {int(k): float(v) for k, v in zip(keys[:n], vals[:n])}
+            cap = int(n)
+
+    def noshare_list(self) -> list[dict]:
+        return [
+            self._hist(self._lib.pluss_get_noshare, t)
+            for t in range(self.thread_num)
+        ]
+
+    def share_list(self) -> list[dict]:
+        out = []
+        for t in range(self.thread_num):
+            h = self._hist(self._lib.pluss_get_share, t)
+            out.append({self.thread_num - 1: h} if h else {})
+        return out
+
+    def rihist(self) -> dict:
+        return self._hist(self._lib.pluss_get_ri)
+
+    def mrc(self) -> np.ndarray:
+        n = self._lib.pluss_get_mrc(self._h, None, 0)
+        out = np.empty(n, np.float64)
+        got = self._lib.pluss_get_mrc(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n
+        )
+        assert got == n
+        return out
+
+    @property
+    def max_iteration_count(self) -> int:
+        return int(self._lib.pluss_total_count(self._h))
+
+
+def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT) -> NativeResult:
+    """Run sampler + CRI in the native runtime."""
+    lib = _load()
+    toks = spec_tokens(spec)
+    elems = np.asarray([n for _, n in spec.arrays], np.int64)
+    h = lib.pluss_run(
+        toks.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), len(toks),
+        elems.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), len(elems),
+        cfg.thread_num, cfg.chunk_size, cfg.ds, cfg.cls, cfg.cache_kb,
+    )
+    if not h:
+        raise ValueError("native runtime rejected the spec")
+    return NativeResult(h, lib, cfg.thread_num)

@@ -2,13 +2,19 @@
 # Differential acc|speed driver, mirroring the reference's run.sh
 # (/root/reference/run.sh): run the native C++ baseline first (if built), then
 # the TPU backends, all appending blocks to output.txt for side-by-side diffing.
+#
+# PLUSS_CLI_FLAGS defaults to --cpu because this image's tunneled-TPU backend
+# hangs when the tunnel is wedged; set PLUSS_CLI_FLAGS="" for a real TPU run.
 set -e
 METHOD=${1:-acc}
+N=${2:-128}
+CLI_FLAGS=${PLUSS_CLI_FLAGS---cpu}
 
+if [ ! -f pluss/cpp/build/pluss_cpp ] && [ -d pluss/cpp ]; then
+  (cd pluss/cpp && make -s)
+fi
 if [ -f pluss/cpp/build/pluss_cpp ]; then
-  ./pluss/cpp/build/pluss_cpp "$METHOD" >> output.txt
-elif [ -d pluss/cpp ]; then
-  (cd pluss/cpp && make -s) && ./pluss/cpp/build/pluss_cpp "$METHOD" >> output.txt
+  ./pluss/cpp/build/pluss_cpp "$METHOD" "$N" >> output.txt
 fi
 
-python -m pluss.cli "$METHOD" >> output.txt
+python -m pluss.cli "$METHOD" --n "$N" $CLI_FLAGS >> output.txt

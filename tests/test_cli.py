@@ -84,6 +84,20 @@ def test_mrc_mode(tmp_path, capsys):
     assert text[1].startswith("0, 1")
 
 
+def test_acc_block_with_pri(gemm16):
+    from pluss.io import PRI_TITLE, merge_pri
+
+    res, ri = gemm16
+    buf = _io.StringIO()
+    acc_block("TPU VMAP", 0.0, res.noshare_list(), res.share_list(), ri,
+              res.max_iteration_count, buf, with_pri=True)
+    assert PRI_TITLE in buf.getvalue()
+    pri = merge_pri(res.noshare_list(), res.share_list())
+    # pri = noshare keys plus raw share keys, counts preserved
+    assert sum(pri.values()) == sum(merge_noshare(res.noshare_list()).values()) \
+        + sum(merge_share(res.share_list()).values())
+
+
 def test_merge_share_raw_keys(gemm16):
     res, _ = gemm16
     m = merge_share(res.share_list())

@@ -1,0 +1,93 @@
+"""Native C++ runtime parity vs the XLA engine, oracle, and MRC solver.
+
+Builds pluss/cpp on first use (skips if no toolchain).  The cross-language
+agreement here is the framework's version of the reference's differential
+`acc` test (SURVEY.md §4): C++ and TPU paths must emit identical histograms.
+"""
+
+import numpy as np
+import pytest
+
+from pluss import cri, engine, mrc, native
+from pluss.config import SamplerConfig
+from pluss.models import REGISTRY, gemm
+
+pytestmark = pytest.mark.skipif(
+    not native.available(autobuild=True), reason="native toolchain unavailable"
+)
+
+
+def _merge(ds):
+    out = {}
+    for d in ds:
+        for k, v in d.items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+@pytest.mark.parametrize("model", sorted(REGISTRY))
+def test_native_matches_engine(model):
+    n = 8 if model == "stencil3d" else 16
+    spec = REGISTRY[model](n)
+    nat = native.run(spec)
+    eng = engine.run(spec)
+    assert nat.max_iteration_count == eng.max_iteration_count
+    assert nat.noshare_list() == eng.noshare_list()
+    assert nat.share_list() == eng.share_list()
+
+
+def test_native_ri_matches_python_cri():
+    spec = gemm(16)
+    nat = native.run(spec)
+    py_ri = cri.distribute(nat.noshare_list(), nat.share_list(), 4)
+    nat_ri = nat.rihist()
+    assert set(nat_ri) == set(py_ri)
+    for k in py_ri:
+        assert nat_ri[k] == pytest.approx(py_ri[k], rel=1e-12), k
+
+
+def test_native_mrc_matches_python_aet():
+    spec = gemm(16)
+    nat = native.run(spec)
+    py = mrc.aet_mrc(nat.rihist())
+    cc = nat.mrc()
+    assert len(cc) == len(py)
+    np.testing.assert_allclose(cc, py, rtol=1e-12)
+
+
+def test_native_nondefault_config():
+    cfg = SamplerConfig(thread_num=2, chunk_size=3)
+    spec = gemm(13)  # odd size: partial chunks
+    nat = native.run(spec, cfg)
+    eng = engine.run(spec, cfg)
+    assert nat.noshare_list() == eng.noshare_list()
+    assert nat.share_list() == eng.share_list()
+
+
+def test_native_rejects_malformed_tokens():
+    import ctypes
+
+    lib = native._load()
+    bad = np.asarray([1, 7, 7], np.int64)  # bad node tag
+    elems = np.asarray([4], np.int64)
+    h = lib.pluss_run(
+        bad.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), len(bad),
+        elems.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), 1,
+        4, 4, 8, 64, 2560,
+    )
+    assert not h
+
+
+def test_standalone_binary_gemm128_golden():
+    import subprocess
+
+    out = subprocess.run(
+        [native.BIN_PATH, "acc", "128"], capture_output=True, text=True,
+        check=True,
+    ).stdout
+    assert "max iteration traversed\n8421376" in out
+    assert "Start to dump noshare private reuse time" in out
+    # merged noshare golden (tests/test_oracle.py derivation)
+    for line in ("-1,12288,", "1,2.12787e+06,", "512,1.83501e+06,"):
+        assert line in out, line
+    assert "62194,253952,1" in out  # the single share value
