@@ -1,6 +1,7 @@
 // C ABI for ctypes (pybind11 is not in this image; plain C symbols instead).
 // A handle owns one run's results; getters copy histograms into caller arrays.
 #include <cstring>
+#include <memory>
 #include <new>
 
 #include "pluss_rt.hpp"
@@ -36,13 +37,13 @@ void* pluss_run(const long long* tokens, long long n_tokens,
                 const long long* array_elems, int n_arrays, int thread_num,
                 int chunk_size, int ds, int cls, long long cache_kb) {
   try {
-    auto* h = new Handle;
+    auto h = std::make_unique<Handle>();
     h->cfg = {thread_num, chunk_size, ds, cls, cache_kb};
     pluss::Spec spec =
         pluss::parse_spec(tokens, n_tokens, array_elems, n_arrays, ds, cls);
     h->res = pluss::run_sampler(spec, h->cfg);
     h->ri = pluss::cri_distribute(h->res, h->cfg);
-    return h;
+    return h.release();
   } catch (...) {
     return nullptr;
   }

@@ -101,8 +101,16 @@ int main(int argc, char** argv) {
       if (res.total_count == 0) return 1;
     }
     std::printf("\n");
+  } else if (mode == "mrc") {
+    // native twin of `python -m pluss.cli mrc` (the dormant titular
+    // capability of the reference, live here)
+    const char* path = argc > 3 ? argv[3] : "mrc.csv";
+    pluss::SampleResult res = pluss::run_sampler(spec, cfg);
+    std::vector<double> mrc = pluss::aet_mrc(pluss::cri_distribute(res, cfg), cfg);
+    pluss::write_mrc(mrc, path);
+    std::printf("wrote MRC over %zu cache sizes to %s\n", mrc.size(), path);
   } else {
-    std::fprintf(stderr, "usage: %s {acc|speed} [n]\n", argv[0]);
+    std::fprintf(stderr, "usage: %s {acc|speed|mrc} [n] [mrc_path]\n", argv[0]);
     return 2;
   }
   return 0;

@@ -307,7 +307,7 @@ void write_mrc(const std::vector<double>& mrc, const char* path) {
   size_t i1 = 0, n = mrc.size();
   while (i1 < n) {
     size_t i2 = i1;
-    while (i2 + 1 < n && mrc[i1] - mrc[i2 + 1] < 1e-5) ++i2;
+    while (i2 + 1 < n && mrc[i1] - mrc[i2 + 1] < kMrcDedupEps) ++i2;
     std::fprintf(f, "%zu, %g\n", i1, mrc[i1]);
     if (i1 != i2) std::fprintf(f, "%zu, %g\n", i2, mrc[i2]);
     i1 = i2 + 1;

@@ -83,6 +83,7 @@ void cri_racetrack(const std::vector<Histogram>& share, Histogram& ri,
 Histogram cri_distribute(const SampleResult& r, const Config& cfg); // :1204-1208
 
 // ---- AET -> MRC (pluss_utils.h:758-804, 851-913) ---------------------------
+constexpr double kMrcDedupEps = 1e-5;  // pluss_utils.h:863,899
 std::vector<double> aet_mrc(const Histogram& ri, const Config& cfg);
 void write_mrc(const std::vector<double>& mrc, const char* path);
 

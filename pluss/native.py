@@ -31,24 +31,29 @@ _lib = None
 
 
 def build(quiet: bool = True) -> None:
-    """Build the native runtime in place (requires g++)."""
-    subprocess.run(
-        ["make", "-C", CPP_DIR] + (["-s"] if quiet else []),
-        check=True,
-        capture_output=quiet,
-    )
+    """(Re)build the native runtime in place (requires g++).  Incremental:
+    no-ops when build/ is current, so callers invoke it unconditionally."""
+    try:
+        subprocess.run(
+            ["make", "-C", CPP_DIR] + (["-s"] if quiet else []),
+            check=True,
+            capture_output=quiet,
+        )
+    except subprocess.CalledProcessError as e:
+        # a real compile failure must FAIL, not skip-as-unavailable
+        err = (e.stderr or b"").decode(errors="replace")[-2000:]
+        raise RuntimeError(f"native build failed:\n{err}") from e
 
 
 def available(autobuild: bool = False) -> bool:
-    if os.path.exists(LIB_PATH):
-        return True
+    """True when the native lib is present (after an up-to-date rebuild if
+    ``autobuild``).  False only for a genuinely missing toolchain."""
     if autobuild:
         try:
             build()
-        except (OSError, subprocess.CalledProcessError):
-            return False
-        return os.path.exists(LIB_PATH)
-    return False
+        except FileNotFoundError:
+            return False  # no make/g++ on this box
+    return os.path.exists(LIB_PATH)
 
 
 def _load():

@@ -78,6 +78,23 @@ def test_native_rejects_malformed_tokens():
     assert not h
 
 
+def test_standalone_binary_mrc_mode(tmp_path):
+    import subprocess
+
+    path = tmp_path / "m.csv"
+    out = subprocess.run(
+        [native.BIN_PATH, "mrc", "16", str(path)], capture_output=True,
+        text=True, check=True,
+    ).stdout
+    assert "wrote MRC" in out
+    lines = path.read_text().splitlines()
+    assert lines[0] == "miss ratio"
+    # native dedup printer must agree with the Python one on the same curve
+    nat = native.run(gemm(16))
+    py_lines = [f"{c}, {v:g}" for c, v in mrc.dedup_lines(nat.mrc())]
+    assert lines[1:] == py_lines
+
+
 def test_standalone_binary_gemm128_golden():
     import subprocess
 
