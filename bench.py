@@ -56,16 +56,14 @@ def probe_accelerator() -> str | None:
 
 def native_baseline_s(n: int) -> float | None:
     """Mean seconds/run of the native C++ sampler+CRI at size n, or None."""
-    bin_path = os.path.join("pluss", "cpp", "build", "pluss_cpp")
-    if not os.path.exists(bin_path):
-        try:
-            subprocess.run(["make", "-C", os.path.join("pluss", "cpp"), "-s"],
-                           check=True, capture_output=True)
-        except (OSError, subprocess.CalledProcessError) as e:
-            log(f"bench: native build failed: {e}")
-            return None
+    from pluss import native
+
+    if not native.available(autobuild=True):  # incremental: no stale binary
+        log("bench: native toolchain unavailable")
+        return None
     try:
-        out = subprocess.run([bin_path, "speed", str(n)], capture_output=True,
+        out = subprocess.run([native.BIN_PATH, "speed", str(n)],
+                             capture_output=True,
                              text=True, timeout=3600, check=True).stdout
     except (OSError, subprocess.CalledProcessError,
             subprocess.TimeoutExpired) as e:
@@ -89,13 +87,15 @@ def main() -> int:
         log(f"bench: accelerator platform {plat!r}, N={n}")
 
     from pluss import cri, engine
+    from pluss.config import DEFAULT
     from pluss.models import gemm
 
     spec = gemm(n)
 
     def step():
         res = engine.run(spec)
-        cri.distribute(res.noshare_list(), res.share_list(), 4)
+        cri.distribute(res.noshare_list(), res.share_list(),
+                       DEFAULT.thread_num)
         return res
 
     t0 = time.perf_counter()

@@ -61,6 +61,15 @@ class NestPlan:
     owned: np.ndarray         # [T, NW*W] global chunk ids, -1 = none
     window_rounds: int        # W
     n_windows: int            # NW
+    # Static-sort fast path (None when ineligible): the sampler's stream is
+    # fully deterministic, and under the shift-invariance conditions of
+    # _static_perm_eligible the (line, pos) sort order of every *clean* window
+    # (all chunks full, all threads, all windows) is IDENTICAL — so the sort
+    # permutation is computed once on the host at plan time and the device
+    # replaces its O(n log n) sort with two O(n) gathers.
+    perm: np.ndarray | None = None         # [W*CS*body] int32
+    span_sorted: np.ndarray | None = None  # [W*CS*body] int32
+    clean: np.ndarray | None = None        # [T, NW] bool: window is clean
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -117,6 +126,90 @@ def _owned_matrix(sched: ChunkSchedule, T: int,
     return out
 
 
+def _np_ref_window(fr: FlatRef, np_rounds: int, cfg: SamplerConfig, sched,
+                   owned_row: np.ndarray, r0: int, line_base: int):
+    """Host (numpy) twin of :func:`_ref_window`, used to precompute the static
+    sort permutation.  Mirrors the device formulas except the nest_base pos
+    offset: a constant shift of every pos is order-invariant under lexsort,
+    so the permutation provably cannot depend on it."""
+    CS = cfg.chunk_size
+    shape = (np_rounds, CS) + fr.trips[1:]
+    nd = len(shape)
+
+    def iota(axis):
+        return np.arange(shape[axis], dtype=np.int64).reshape(
+            (1,) * axis + (-1,) + (1,) * (nd - axis - 1)
+        )
+
+    r, p = iota(0), iota(1)
+    cid = owned_row[r0 + r]
+    g = cid * CS + p
+    rank = (r0 + r) * CS + p
+    pos = rank * fr.pos_strides[0] + fr.offset
+    addr = fr.ref.addr_base + fr.addr_coefs[0] * (sched.start + g * sched.step)
+    for l in range(1, len(fr.trips)):
+        idx = iota(l + 1)
+        pos = pos + idx * fr.pos_strides[l]
+        if fr.addr_coefs[l]:
+            addr = addr + fr.addr_coefs[l] * (fr.starts[l] + idx * fr.steps[l])
+    line = line_base + addr * cfg.ds // cfg.cls
+    line, pos = np.broadcast_to(line, shape), np.broadcast_to(pos, shape)
+    return line.reshape(-1), pos.reshape(-1)
+
+
+def _static_perm_eligible(refs: tuple[FlatRef, ...], sched,
+                          cfg: SamplerConfig) -> bool:
+    """Shift-invariance of the window sort order across threads and windows.
+
+    Two conditions, checked per nest:
+    - every ref of the same array has the same parallel-dim address
+      coefficient (else their relative line order shifts between windows, as
+      in syrk's A[i][k] vs A[j][k]);
+    - each ref's per-chunk address shift lands on a whole number of cache
+      lines (``coef0 * CS * step * DS % CLS == 0``), so the floor division
+      to lines shifts rigidly.
+    Cross-array order is always rigid: line ids live in disjoint
+    [base, base+count) ranges.
+    """
+    coef_by_array: dict[str, int] = {}
+    for fr in refs:
+        c0 = fr.addr_coefs[0]
+        seen = coef_by_array.setdefault(fr.ref.array, c0)
+        if seen != c0:
+            return False
+        if (abs(c0 * cfg.chunk_size * sched.step) * cfg.ds) % cfg.cls:
+            return False
+    return True
+
+
+def _clean_windows(owned: np.ndarray, W: int, NW: int, CS: int,
+                   trip: int) -> np.ndarray:
+    """[T, NW] bool: every chunk of the window exists and is full."""
+    cids = owned.reshape(owned.shape[0], NW, W)
+    return (cids >= 0).all(axis=2) & (cids.max(axis=2) * CS + CS <= trip)
+
+
+def _build_static_perm(refs, W, cfg, sched, owned, clean, bases, array_index):
+    """(perm, span_sorted) from the first clean window, or (None, None)."""
+    t_w = np.argwhere(clean)
+    if len(t_w) == 0:
+        return None, None
+    t, w = int(t_w[0, 0]), int(t_w[0, 1])
+    lines, poss, spans = [], [], []
+    for fr in refs:
+        line, pos = _np_ref_window(
+            fr, W, cfg, sched, owned[t], w * W,
+            bases[array_index(fr.ref.array)],
+        )
+        lines.append(line)
+        poss.append(pos)
+        spans.append(np.full(line.shape, fr.ref.share_span or 0, np.int32))
+    line = np.concatenate(lines)
+    pos = np.concatenate(poss)
+    perm = np.lexsort((pos, line)).astype(np.int32)
+    return perm, np.concatenate(spans)[perm]
+
+
 def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
          assignment: tuple[tuple[int, ...] | None, ...] | None = None,
          start_point: int | None = None,
@@ -150,7 +243,17 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             NW = -(-R // W)
         pad = np.full((T, NW * W - R), -1, np.int32)
         owned = np.concatenate([owned, pad], axis=1)
-        nests.append(NestPlan(sched, refs, body, owned, W, NW))
+        perm = span_sorted = clean = None
+        # custom chunk->thread maps break the linear cid progression the
+        # shift-invariance argument rests on; the sort path handles them
+        if asg is None and _static_perm_eligible(refs, sched, cfg):
+            clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
+            perm, span_sorted = _build_static_perm(
+                refs, W, cfg, sched, owned, clean, spec.line_bases(cfg),
+                spec.array_index,
+            )
+        nests.append(NestPlan(sched, refs, body, owned, W, NW,
+                              perm, span_sorted, clean))
         for t in range(T):
             for cid in owned[t]:
                 if cid >= 0:
@@ -245,7 +348,7 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
         owned_row = jnp.asarray(np_.owned)[tid]
         nb = nest_base[ni, tid]
 
-        def step(carry, r0, np_=np_, owned_row=owned_row, nb=nb):
+        def sort_step(carry, r0, np_=np_, owned_row=owned_row, nb=nb):
             last_pos, hist = carry
             stream = window_stream(np_, cfg, owned_row, r0, nb, bases,
                                    pl.spec.array_index, pdt)
@@ -254,12 +357,79 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
             sv, sc, snu = share_unique(ev, share_cap)
             return (last_pos, hist), (sv, sc, snu)
 
-        r0s = jnp.arange(np_.n_windows, dtype=jnp.int32) * np_.window_rounds
-        if np_.n_windows == 1:
-            (last_pos, hist), ys = step((last_pos, hist), r0s[0])
-            ys = jax.tree.map(lambda a: a[None], ys)
+        if np_.perm is not None:
+            perm_j = jnp.asarray(np_.perm)
+            span_j = jnp.asarray(np_.span_sorted)
+            ones_i = jnp.ones(np_.perm.shape, jnp.int32)
+            # share-capable slots are static under the permutation, so the
+            # share-unique sort runs on that (much smaller) substream only
+            share_idx = jnp.asarray(np.nonzero(np_.span_sorted > 0)[0])
+
+            def fast_step(carry, r0, np_=np_, owned_row=owned_row, nb=nb,
+                          perm_j=perm_j, span_j=span_j, ones_i=ones_i,
+                          share_idx=share_idx):
+                last_pos, hist = carry
+                parts = [
+                    _ref_window(fr, np_, cfg, owned_row, r0, nb,
+                                bases[pl.spec.array_index(fr.ref.array)], pdt)
+                    for fr in np_.refs
+                ]
+                line = jnp.concatenate([p[0] for p in parts])[perm_j]
+                pos = jnp.concatenate([p[1] for p in parts])[perm_j]
+                ev, last_pos = window_events(line, pos, span_j, ones_i,
+                                             last_pos)
+                hist = hist + event_histogram(ev)
+                if share_idx.shape[0]:
+                    sub = {
+                        "reuse": ev["reuse"][share_idx],
+                        "share": ev["share"][share_idx],
+                    }
+                    sv, sc, snu = share_unique(sub, share_cap)
+                else:
+                    sv = jnp.zeros((share_cap,), ev["reuse"].dtype)
+                    sc = jnp.zeros((share_cap,), jnp.int32)
+                    snu = jnp.int32(0)
+                return (last_pos, hist), (sv, sc, snu)
         else:
-            (last_pos, hist), ys = jax.lax.scan(step, (last_pos, hist), r0s)
+            fast_step = None
+
+        # windows processed in order as (fast | sort) segments: a window takes
+        # the gather path only when it is clean for EVERY thread (vmap runs
+        # threads in lockstep)
+        fast_w = (
+            np_.clean.all(axis=0)
+            if fast_step is not None
+            else np.zeros(np_.n_windows, bool)
+        )
+        segments: list[tuple[bool, list[int]]] = []
+        for w in range(np_.n_windows):
+            r0 = w * np_.window_rounds
+            if segments and segments[-1][0] == bool(fast_w[w]):
+                segments[-1][1].append(r0)
+            else:
+                segments.append((bool(fast_w[w]), [r0]))
+
+        ys_parts = []
+        for is_fast, r0_list in segments:
+            body = fast_step if is_fast else sort_step
+            if len(r0_list) == 1:
+                (last_pos, hist), ys = body(
+                    (last_pos, hist), jnp.int32(r0_list[0])
+                )
+                ys = jax.tree.map(lambda a: a[None], ys)
+            else:
+                (last_pos, hist), ys = jax.lax.scan(
+                    body, (last_pos, hist),
+                    jnp.asarray(r0_list, jnp.int32),
+                )
+            ys_parts.append(ys)
+        ys = (
+            ys_parts[0]
+            if len(ys_parts) == 1
+            else jax.tree.map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *ys_parts
+            )
+        )
         share_ys.append(ys)
     return hist, share_ys
 

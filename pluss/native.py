@@ -47,12 +47,13 @@ def build(quiet: bool = True) -> None:
 
 def available(autobuild: bool = False) -> bool:
     """True when the native lib is present (after an up-to-date rebuild if
-    ``autobuild``).  False only for a genuinely missing toolchain."""
+    ``autobuild``).  A missing toolchain (no make, or make without g++) falls
+    back to any prebuilt lib; only a clean box with neither returns False."""
     if autobuild:
         try:
             build()
-        except FileNotFoundError:
-            return False  # no make/g++ on this box
+        except (FileNotFoundError, RuntimeError):
+            pass  # no toolchain — a prebuilt lib may still exist
     return os.path.exists(LIB_PATH)
 
 

@@ -114,17 +114,31 @@ def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
         return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)
     lines = addrs.astype(np.int64) if precompacted else lines_of(addrs, cls)
 
-    # host compaction: incremental vocabulary over chunks (bounded memory)
-    vocab: dict[int, int] = {}
+    # host compaction: incremental vocabulary over chunks, fully vectorized
+    # (sorted key array + parallel id array; ids are assignment-ordered and
+    # stay stable as the vocabulary grows)
+    keys_sorted = np.empty(0, np.int64)
+    ids_sorted = np.empty(0, np.int32)
+    next_id = 0
     ids = np.empty(n, np.int32)
     for lo in range(0, n, window):
         chunk = lines[lo:lo + window]
-        uniq, inv = np.unique(chunk, return_inverse=True)
-        mapped = np.empty(len(uniq), np.int32)
-        for i, u in enumerate(uniq.tolist()):
-            mapped[i] = vocab.setdefault(u, len(vocab))
-        ids[lo:lo + window] = mapped[inv]
-    n_lines = len(vocab)
+        uniq = np.unique(chunk)
+        pos = np.searchsorted(keys_sorted, uniq)
+        if len(keys_sorted):
+            hit = np.minimum(pos, len(keys_sorted) - 1)
+            is_new = keys_sorted[hit] != uniq
+        else:
+            is_new = np.ones(len(uniq), bool)
+        new_keys = uniq[is_new]
+        keys_sorted = np.insert(keys_sorted, pos[is_new], new_keys)
+        ids_sorted = np.insert(
+            ids_sorted, pos[is_new],
+            np.arange(next_id, next_id + len(new_keys), dtype=np.int32),
+        )
+        next_id += len(new_keys)
+        ids[lo:lo + window] = ids_sorted[np.searchsorted(keys_sorted, chunk)]
+    n_lines = next_id
 
     n_windows = -(-n // window)
     pad = n_windows * window - n

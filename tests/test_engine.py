@@ -111,3 +111,29 @@ def test_gemm128_matches_golden():
             share[k] = share.get(k, 0.0) + v
     assert noshare == GOLD_NOSHARE_128
     assert share == GOLD_SHARE_128
+
+
+def test_static_perm_eligibility():
+    """Fast (host-permutation) path activates exactly where the
+    shift-invariance conditions hold."""
+    from pluss.engine import plan
+    from pluss.models import REGISTRY
+
+    assert plan(gemm(16)).nests[0].perm is not None
+    # syrk reads A with two different parallel-dim coefficients -> sort path
+    assert plan(REGISTRY["syrk"](16)).nests[0].perm is None
+    # odd N: per-chunk shift not a whole number of cache lines -> sort path
+    assert plan(gemm(13)).nests[0].perm is None
+    # custom assignment breaks the linear cid progression -> sort path
+    assert plan(gemm(16), assignment=((0, 1, 2, 3),)).nests[0].perm is None
+
+
+def test_fast_path_matches_sort_path():
+    """Force multi-window so fast (gather) and sort bodies both execute and
+    the carried last_pos hands off between them; compare against the default
+    plan and the oracle-backed goldens via run()."""
+    spec = gemm(32)
+    base = run(spec)
+    small_windows = run(spec, window_accesses=4096)  # several windows
+    assert base.noshare_list() == small_windows.noshare_list()
+    assert base.share_list() == small_windows.share_list()
