@@ -1,0 +1,162 @@
+"""pluss.iteration: interleaving order, equality/dedup, hashing.
+
+The scalar :func:`pluss.iteration.compare` is the executable spec
+(iteration.rs:151-194 semantics); the vectorized key matrix must sort any
+batch identically.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+
+import numpy as np
+import pytest
+
+from pluss.iteration import (
+    HASH_IV_BITS,
+    IterationPoint,
+    compare,
+    dedup,
+    interleaved_argsort,
+    iv_bitmap,
+    order_keys,
+    point_hash,
+)
+from pluss.sched import ChunkSchedule
+
+
+def _sched(trip=32, cs=4, T=4):
+    return ChunkSchedule(cs, trip, 0, 1, T)
+
+
+def _random_points(rng, n, trip, depth, n_refs=4):
+    """Random fixed-depth points; priority = ref id (distinct per ref)."""
+    pts = []
+    for _ in range(n):
+        ref = rng.randrange(n_refs)
+        ivs = tuple(rng.randrange(trip) for _ in range(depth))
+        pts.append(IterationPoint(f"R{ref}", ivs, priority=n_refs - ref))
+    return pts
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_lexsort_matches_scalar_comparator(depth):
+    rng = random.Random(20260730 + depth)
+    sched = _sched()
+    pts = _random_points(rng, 200, sched.trip, depth)
+    ivs = np.array([p.ivs for p in pts])
+    prios = np.array([p.priority for p in pts])
+    idx = interleaved_argsort(ivs, prios, sched)
+    got = [pts[i] for i in idx]
+    want = sorted(pts, key=functools.cmp_to_key(
+        lambda a, b: compare(a, b, sched)))
+    key = lambda p: (p.ivs, p.priority)
+    assert [key(p) for p in got] == [key(p) for p in want]
+
+
+def test_comparator_orders_by_round_pos_then_tid():
+    """Uniform interleaving: round-major, in-chunk pos, inner ivs, tid."""
+    sched = _sched(trip=32, cs=4, T=4)
+    c = IterationPoint("A", (1, 0))      # cid 0, tid 0, pos 1
+    d = IterationPoint("A", (16, 0))     # cid 1, tid 0, pos 0
+    # same (cid, pos): inner ivs decide before tid
+    assert compare(IterationPoint("A", (0, 5)),
+                   IterationPoint("A", (4, 0)), sched) == 1
+    # inner ivs equal: tid decides
+    a2 = IterationPoint("A", (0, 7))
+    b2 = IterationPoint("A", (4, 7))
+    assert compare(a2, b2, sched) == -1  # tid 0 < tid 1
+    assert compare(b2, c, sched) == -1   # pos 0 < pos 1 beats tid/ivs
+    assert compare(c, d, sched) == -1    # cid 0 < cid 1 dominates
+    # priority: higher executes earlier
+    hi = IterationPoint("A", (0, 7), priority=2)
+    lo = IterationPoint("B", (0, 7), priority=1)
+    assert compare(hi, lo, sched) == -1
+
+
+def test_single_thread_order_is_program_order():
+    """Points of one simulated thread sort into that thread's walk order."""
+    sched = _sched(trip=8, cs=2, T=2)
+    # nest: for i (parallel) / for j: R0[i,j]; R1[i,j]  (priority 2, 1)
+    pts, walk = [], []
+    for tid in range(2):
+        per = []
+        for cid in sched.chunks_of_thread(tid):
+            b, e = sched.chunk_index_range(cid)
+            for i in range(b, e):
+                for j in range(4):
+                    per.append(("R0", (i, j)))
+                    per.append(("R1", (i, j)))
+        walk.append(per)
+    for tid in range(2):
+        pts = [IterationPoint(nm, iv, priority=2 if nm == "R0" else 1)
+               for nm, iv in walk[tid]]
+        rng = random.Random(tid)
+        shuf = pts[:]
+        rng.shuffle(shuf)
+        ivs = np.array([p.ivs for p in shuf])
+        prios = np.array([p.priority for p in shuf])
+        idx = interleaved_argsort(ivs, prios, sched)
+        assert [(shuf[i].name, shuf[i].ivs) for i in idx] == walk[tid]
+
+
+def test_mixed_depth_prefix_points():
+    """A shallower ref sorts against deeper ones via common ivs + priority."""
+    sched = _sched(trip=8, cs=4, T=2)
+    # C0 at (i,j) [priority 3] precedes A0/B0 at (i,j,k) [2,1]
+    pts = [
+        IterationPoint("A0", (0, 1, 0), priority=2),
+        IterationPoint("C0", (0, 1), priority=3),
+        IterationPoint("B0", (0, 1, 0), priority=1),
+        IterationPoint("C0", (0, 2), priority=3),
+        IterationPoint("A0", (0, 1, 1), priority=2),
+    ]
+    want = sorted(pts, key=functools.cmp_to_key(
+        lambda a, b: compare(a, b, sched)))
+    ivs = np.full((len(pts), 3), 0, np.int64)
+    lens = np.array([len(p.ivs) for p in pts])
+    for i, p in enumerate(pts):
+        ivs[i, : len(p.ivs)] = p.ivs
+    idx = interleaved_argsort(
+        ivs, np.array([p.priority for p in pts]), sched, lengths=lens)
+    got = [pts[i] for i in idx]
+    assert [(p.name, p.ivs) for p in got] == [(p.name, p.ivs) for p in want]
+    # and the expected program order explicitly:
+    assert [p.name for p in want] == ["C0", "A0", "B0", "A0", "C0"]
+
+
+def test_iv_bitmap_packing_and_truncation():
+    ivs = np.array([[1, 2, 3], [1, 2, 4]])
+    bm = iv_bitmap(ivs)
+    assert bm[0] == (1 << 2 * HASH_IV_BITS) | (2 << HASH_IV_BITS) | 3
+    assert bm[0] != bm[1]
+    # 4th iv does not contribute (3-slot truncation, iteration.rs:202-208)
+    a = iv_bitmap(np.array([[1, 2, 3, 7]]))
+    b = iv_bitmap(np.array([[1, 2, 3, 9]]))
+    assert a[0] == b[0]
+
+
+def test_point_hash_and_dedup():
+    names = np.array([0, 0, 1, 0])
+    ivs = np.array([[1, 2], [1, 2], [1, 2], [3, 4]])
+    h = point_hash(names, ivs)
+    assert h[0] == h[1] and h[0] != h[2]  # same point; name distinguishes
+    keep = dedup(names, ivs)
+    assert keep.tolist() == [0, 2, 3]
+    # equality uses FULL ivs (no 3-slot truncation, iteration.rs:137-149)
+    names4 = np.array([0, 0])
+    ivs4 = np.array([[1, 2, 3, 7], [1, 2, 3, 9]])
+    assert dedup(names4, ivs4).tolist() == [0, 1]
+    assert point_hash(names4, ivs4)[0] == point_hash(names4, ivs4)[1]
+
+
+def test_decompose_matches_schedule():
+    sched = _sched(trip=64, cs=4, T=4)
+    for v in range(0, 64, 7):
+        p = IterationPoint("X", (v, 0))
+        cid, tid, pos = p.decompose(sched)
+        assert cid == sched.static_chunk_id(v)
+        assert tid == sched.static_tid(v)
+        assert pos == sched.static_thread_local_pos(v)
+        assert sched.chunk_owner(sched.start_chunk_of(v)) == tid
