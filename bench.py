@@ -58,7 +58,12 @@ def native_baseline_s(n: int) -> float | None:
     """Mean seconds/run of the native C++ sampler+CRI at size n, or None."""
     from pluss import native
 
-    if not native.available(autobuild=True):  # incremental: no stale binary
+    try:
+        ok = native.available(autobuild=True)  # incremental: no stale binary
+    except RuntimeError as e:  # compile failure: report, never time stale code
+        log(f"bench: native build failed: {e}")
+        return None
+    if not ok:
         log("bench: native toolchain unavailable")
         return None
     try:

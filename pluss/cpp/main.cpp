@@ -66,11 +66,66 @@ Histogram merge_noshare(const std::vector<Histogram>& per_thread) {
   return out;
 }
 
+// -- timing & measurement parity (reference L4, pluss.cpp:45-124) -----------
+// timer_start flushes a cache-sized buffer so each timed rep starts with a
+// cold data cache (pluss.cpp:71-94, POLYBENCH_CACHE_SIZE_KB default 2560);
+// under -DPLUSS_CYCLE_ACCURATE_TIMER the wall clock is replaced by the TSC
+// cycle counter (pluss.cpp:57-69,98-124).
+
+#ifndef POLYBENCH_CACHE_SIZE_KB
+#define POLYBENCH_CACHE_SIZE_KB 2560
+#endif
+
+void flush_cache() {
+  const long long cs = POLYBENCH_CACHE_SIZE_KB * 1024LL / sizeof(double);
+  static std::vector<double> buf(cs, 0.0);
+  double tmp = 0.0;
+  for (long long i = 0; i < cs; ++i) tmp += buf[i];
+  // the sum must stay observable or the flush loop is dead code
+  volatile double sink = tmp;
+  (void)sink;
+}
+
+#ifdef PLUSS_CYCLE_ACCURATE_TIMER
+unsigned long long now_cycles() {
+#if defined(__x86_64__)
+  unsigned hi, lo;
+  __asm__ __volatile__("rdtsc" : "=a"(lo), "=d"(hi));
+  return ((unsigned long long)hi << 32) | lo;
+#else
+  return (unsigned long long)std::chrono::steady_clock::now()
+      .time_since_epoch()
+      .count();
+#endif
+}
+#endif
+
 double now_s() {
   return std::chrono::duration<double>(
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
+
+struct Timer {
+  double t0 = 0.0;
+#ifdef PLUSS_CYCLE_ACCURATE_TIMER
+  unsigned long long c0 = 0;
+#endif
+  void start() {
+    flush_cache();  // pluss_timer_start flushes, then reads the clock
+#ifdef PLUSS_CYCLE_ACCURATE_TIMER
+    c0 = now_cycles();
+#endif
+    t0 = now_s();
+  }
+  double stop() {
+    double dt = now_s() - t0;
+#ifdef PLUSS_CYCLE_ACCURATE_TIMER
+    std::fprintf(stderr, "cycles: %llu\n", now_cycles() - c0);
+#endif
+    return dt;
+  }
+};
 
 }  // namespace
 
@@ -81,10 +136,11 @@ int main(int argc, char** argv) {
   pluss::Spec spec = gemm_spec(n, cfg.ds, cfg.cls);
 
   if (mode == "acc") {
-    double t0 = now_s();
+    Timer t;
+    t.start();
     pluss::SampleResult res = pluss::run_sampler(spec, cfg);
     Histogram ri = pluss::cri_distribute(res, cfg);
-    std::printf("NATIVE C++: %0.6f\n", now_s() - t0);
+    std::printf("NATIVE C++: %0.6f\n", t.stop());
     print_hist("Start to dump noshare private reuse time",
                merge_noshare(res.noshare));
     print_hist("Start to dump share private reuse time",
@@ -93,11 +149,12 @@ int main(int argc, char** argv) {
     std::printf("max iteration traversed\n%lld\n\n", res.total_count);
   } else if (mode == "speed") {
     for (int rep = 0; rep < 3; ++rep) {
-      double t0 = now_s();
+      Timer t;
+      t.start();
       pluss::SampleResult res = pluss::run_sampler(spec, cfg);
       Histogram ri = pluss::cri_distribute(res, cfg);
       (void)ri;
-      std::printf("NATIVE C++: %0.6f\n", now_s() - t0);
+      std::printf("NATIVE C++: %0.6f\n", t.stop());
       if (res.total_count == 0) return 1;
     }
     std::printf("\n");

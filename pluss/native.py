@@ -47,12 +47,13 @@ def build(quiet: bool = True) -> None:
 
 def available(autobuild: bool = False) -> bool:
     """True when the native lib is present (after an up-to-date rebuild if
-    ``autobuild``).  A missing toolchain (no make, or make without g++) falls
-    back to any prebuilt lib; only a clean box with neither returns False."""
+    ``autobuild``).  A missing toolchain (no make) falls back to any prebuilt
+    lib; a *failed compile* with the toolchain present propagates — silently
+    timing a stale binary would corrupt every differential/bench result."""
     if autobuild:
         try:
             build()
-        except (FileNotFoundError, RuntimeError):
+        except FileNotFoundError:
             pass  # no toolchain — a prebuilt lib may still exist
     return os.path.exists(LIB_PATH)
 
