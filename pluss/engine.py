@@ -41,6 +41,8 @@ import numpy as np
 from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
 from pluss.ops.reuse import (
     event_histogram,
+    log2_bin,
+    share_mask,
     share_unique,
     sort_stream,
     window_events,
@@ -54,6 +56,44 @@ WINDOW_TARGET = 1 << 23
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowTemplate:
+    """Static structure of one clean window, shared by ALL clean windows.
+
+    The sampler's stream is fully deterministic, and under the
+    shift-invariance conditions of :func:`_static_perm_eligible` every clean
+    window of every thread is a *rigid shift* of every other: same (line, pos)
+    sort order, same in-window reuse intervals, same share classification,
+    same head/tail line structure — only absolute line ids and stream
+    positions move, linearly in ``units = (w - w0)*W*T + (t - t0)`` (the
+    chunk-offset between window ``w`` of thread ``t`` and the template
+    origin).  So the whole *local* (in-window) event analysis is done ONCE on
+    the host at plan time, and the device's per-window work collapses from
+    O(window accesses) to O(lines): resolve the carried ``last_pos`` state at
+    the window's head lines, update it at the tail lines, and add the
+    precomputed local histogram.  This is the "analytic shortcut" structure of
+    affine nests (SURVEY.md §7 hard part 1) — loop-invariant hoisting of the
+    window body, with the sequential carry (the only true data dependence)
+    still resolved on device.
+    """
+
+    t0: int                   # template origin thread
+    w0: int                   # template origin window
+    unit_w: int               # units advanced per window step = W*T
+    pos_shift: int            # positions advanced per window = W*CS*body
+    local_hist: np.ndarray    # [NBINS] in-window (non-head) event histogram
+    share_vals: np.ndarray    # [S] static in-window share reuse values
+    share_cnts: np.ndarray    # [S] their per-window counts
+    head_line: np.ndarray     # [H] int32 first-touch line ids at the origin
+    head_pos: np.ndarray      # [H] their stream positions (origin-relative)
+    head_span: np.ndarray     # [H] int32 share span of the head's ref (0=none)
+    head_dline: np.ndarray    # [H] int32 line shift per unit
+    hs_idx: np.ndarray        # [Hs] indices into H with span>0 (share-capable)
+    tail_line: np.ndarray     # [Ht] int32 last-touch line ids at the origin
+    tail_pos: np.ndarray      # [Ht]
+    tail_dline: np.ndarray    # [Ht] int32
+
+
+@dataclasses.dataclass(frozen=True)
 class NestPlan:
     sched: ChunkSchedule
     refs: tuple[FlatRef, ...]
@@ -61,14 +101,7 @@ class NestPlan:
     owned: np.ndarray         # [T, NW*W] global chunk ids, -1 = none
     window_rounds: int        # W
     n_windows: int            # NW
-    # Static-sort fast path (None when ineligible): the sampler's stream is
-    # fully deterministic, and under the shift-invariance conditions of
-    # _static_perm_eligible the (line, pos) sort order of every *clean* window
-    # (all chunks full, all threads, all windows) is IDENTICAL — so the sort
-    # permutation is computed once on the host at plan time and the device
-    # replaces its O(n log n) sort with two O(n) gathers.
-    perm: np.ndarray | None = None         # [W*CS*body] int32
-    span_sorted: np.ndarray | None = None  # [W*CS*body] int32
+    tpl: WindowTemplate | None = None      # static-window fast path
     clean: np.ndarray | None = None        # [T, NW] bool: window is clean
 
 
@@ -189,25 +222,63 @@ def _clean_windows(owned: np.ndarray, W: int, NW: int, CS: int,
     return (cids >= 0).all(axis=2) & (cids.max(axis=2) * CS + CS <= trip)
 
 
-def _build_static_perm(refs, W, cfg, sched, owned, clean, bases, array_index):
-    """(perm, span_sorted) from the first clean window, or (None, None)."""
+def _build_template(refs, W, cfg, sched, owned, clean, bases, array_index,
+                    body: int) -> WindowTemplate | None:
+    """Analyze the first clean window on the host; None if no window is clean."""
     t_w = np.argwhere(clean)
     if len(t_w) == 0:
-        return None, None
-    t, w = int(t_w[0, 0]), int(t_w[0, 1])
-    lines, poss, spans = [], [], []
+        return None
+    t0, w0 = int(t_w[0, 0]), int(t_w[0, 1])
+    lines, poss, spans, dlines = [], [], [], []
     for fr in refs:
         line, pos = _np_ref_window(
-            fr, W, cfg, sched, owned[t], w * W,
+            fr, W, cfg, sched, owned[t0], w0 * W,
             bases[array_index(fr.ref.array)],
         )
+        # line shift per unit chunk offset; integral by _static_perm_eligible
+        d = fr.addr_coefs[0] * sched.step * cfg.chunk_size * cfg.ds
+        assert d % cfg.cls == 0
         lines.append(line)
         poss.append(pos)
         spans.append(np.full(line.shape, fr.ref.share_span or 0, np.int32))
+        dlines.append(np.full(line.shape, d // cfg.cls, np.int32))
     line = np.concatenate(lines)
     pos = np.concatenate(poss)
-    perm = np.lexsort((pos, line)).astype(np.int32)
-    return perm, np.concatenate(spans)[perm]
+    span = np.concatenate(spans)
+    dline = np.concatenate(dlines)
+    order = np.lexsort((pos, line))
+    line, pos, span, dline = line[order], pos[order], span[order], dline[order]
+
+    same = line[1:] == line[:-1]
+    local = np.concatenate([[False], same])          # has an in-window prev
+    headm = ~local
+    tailm = ~np.concatenate([same, [False]])
+    prev = np.concatenate([[0], pos[:-1]])
+    reuse = np.where(local, pos - prev, 0)
+    share = local & share_mask(reuse, span)
+    evt = local & ~share
+    # slot 1+e for reuse in [2^e, 2^{e+1}): frexp exponent is exactly 1+e
+    slots = np.frexp(reuse[evt].astype(np.float64))[1].astype(np.int64)
+    local_hist = np.bincount(slots, minlength=NBINS).astype(np.int64)
+    share_vals, share_cnts = np.unique(reuse[share], return_counts=True)
+    head_span = span[headm]
+    return WindowTemplate(
+        t0=t0,
+        w0=w0,
+        unit_w=W * cfg.thread_num,
+        pos_shift=W * cfg.chunk_size * body,
+        local_hist=local_hist,
+        share_vals=share_vals.astype(np.int64),
+        share_cnts=share_cnts.astype(np.int64),
+        head_line=line[headm].astype(np.int32),
+        head_pos=pos[headm],
+        head_span=head_span,
+        head_dline=dline[headm],
+        hs_idx=np.nonzero(head_span > 0)[0].astype(np.int32),
+        tail_line=line[tailm].astype(np.int32),
+        tail_pos=pos[tailm],
+        tail_dline=dline[tailm],
+    )
 
 
 def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
@@ -243,17 +314,16 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             NW = -(-R // W)
         pad = np.full((T, NW * W - R), -1, np.int32)
         owned = np.concatenate([owned, pad], axis=1)
-        perm = span_sorted = clean = None
+        tpl = clean = None
         # custom chunk->thread maps break the linear cid progression the
         # shift-invariance argument rests on; the sort path handles them
         if asg is None and _static_perm_eligible(refs, sched, cfg):
             clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
-            perm, span_sorted = _build_static_perm(
+            tpl = _build_template(
                 refs, W, cfg, sched, owned, clean, spec.line_bases(cfg),
-                spec.array_index,
+                spec.array_index, body,
             )
-        nests.append(NestPlan(sched, refs, body, owned, W, NW,
-                              perm, span_sorted, clean))
+        nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean))
         for t in range(T):
             for cid in owned[t]:
                 if cid >= 0:
@@ -263,11 +333,13 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     nest_base = np.zeros_like(iters)
     nest_base[1:] = np.cumsum(iters[:-1] * body_sizes[:-1, None], axis=0)
     total = int((iters * body_sizes[:, None]).sum())
-    # padded per-thread clock bound (with margin) picks the position dtype
+    # padded per-thread clock bound picks the position dtype; the full int32
+    # range is usable because no event math doubles a position (the share
+    # test is division-sided, ops/reuse.py)
     max_clock = int(
         sum(n.n_windows * n.window_rounds * cfg.chunk_size * n.body for n in nests)
     )
-    pos_dtype = np.dtype(np.int32) if max_clock < 2**30 else np.dtype(np.int64)
+    pos_dtype = np.dtype(np.int32) if max_clock < 2**31 - 2 else np.dtype(np.int64)
     if pos_dtype == np.int64 and not jax.config.jax_enable_x64:
         raise RuntimeError(
             f"stream of {max_clock} accesses/thread needs int64 positions; "
@@ -348,79 +420,85 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
         owned_row = jnp.asarray(np_.owned)[tid]
         nb = nest_base[ni, tid]
 
-        def sort_step(carry, r0, np_=np_, owned_row=owned_row, nb=nb):
+        def sort_step(carry, w, np_=np_, owned_row=owned_row, nb=nb):
             last_pos, hist = carry
-            stream = window_stream(np_, cfg, owned_row, r0, nb, bases,
+            stream = window_stream(np_, cfg, owned_row,
+                                   w * np_.window_rounds, nb, bases,
                                    pl.spec.array_index, pdt)
             ev, last_pos = window_events(*stream, last_pos)
             hist = hist + event_histogram(ev)
             sv, sc, snu = share_unique(ev, share_cap)
             return (last_pos, hist), (sv, sc, snu)
 
-        if np_.perm is not None:
-            perm_j = jnp.asarray(np_.perm)
-            span_j = jnp.asarray(np_.span_sorted)
-            ones_i = jnp.ones(np_.perm.shape, jnp.int32)
-            # share-capable slots are static under the permutation, so the
-            # share-unique sort runs on that (much smaller) substream only
-            share_idx = jnp.asarray(np.nonzero(np_.span_sorted > 0)[0])
+        if np_.tpl is not None:
+            tpl = np_.tpl
+            hline = jnp.asarray(tpl.head_line)
+            hpos = jnp.asarray(tpl.head_pos.astype(pl.pos_dtype))
+            hspan = jnp.asarray(tpl.head_span)
+            hdl = jnp.asarray(tpl.head_dline)
+            tline = jnp.asarray(tpl.tail_line)
+            tpos = jnp.asarray(tpl.tail_pos.astype(pl.pos_dtype))
+            tdl = jnp.asarray(tpl.tail_dline)
+            lhist = jnp.asarray(tpl.local_hist.astype(pl.pos_dtype))
+            hs_idx = jnp.asarray(tpl.hs_idx)
+            units0 = tid - tpl.t0
+            shift_w = jnp.asarray(tpl.pos_shift, pdt)
 
-            def fast_step(carry, r0, np_=np_, owned_row=owned_row, nb=nb,
-                          perm_j=perm_j, span_j=span_j, ones_i=ones_i,
-                          share_idx=share_idx):
+            def ultra_step(carry, w, tpl=tpl, hline=hline, hpos=hpos,
+                           hspan=hspan, hdl=hdl, tline=tline,
+                           tpos=tpos, tdl=tdl, lhist=lhist, hs_idx=hs_idx,
+                           units0=units0, shift_w=shift_w, nb=nb):
                 last_pos, hist = carry
-                parts = [
-                    _ref_window(fr, np_, cfg, owned_row, r0, nb,
-                                bases[pl.spec.array_index(fr.ref.array)], pdt)
-                    for fr in np_.refs
-                ]
-                line = jnp.concatenate([p[0] for p in parts])[perm_j]
-                pos = jnp.concatenate([p[1] for p in parts])[perm_j]
-                ev, last_pos = window_events(line, pos, span_j, ones_i,
-                                             last_pos)
-                hist = hist + event_histogram(ev)
-                if share_idx.shape[0]:
-                    sub = {
-                        "reuse": ev["reuse"][share_idx],
-                        "share": ev["share"][share_idx],
-                    }
+                units = (w - tpl.w0) * tpl.unit_w + units0
+                dpos = (w - tpl.w0).astype(pdt) * shift_w + nb
+                carried = last_pos[hline + hdl * units]
+                cold = carried < 0
+                reuse = (hpos + dpos) - carried
+                share = ~cold & share_mask(reuse, hspan)
+                evt = ~cold & ~share
+                bins = jnp.where(evt, log2_bin(reuse), 0)
+                wgt = (cold | evt).astype(pdt)
+                hist = hist + lhist + jax.ops.segment_sum(
+                    wgt, bins, num_segments=NBINS)
+                last_pos = last_pos.at[tline + tdl * units].set(tpos + dpos)
+                if tpl.hs_idx.shape[0]:
+                    sub = {"reuse": reuse[hs_idx], "share": share[hs_idx]}
                     sv, sc, snu = share_unique(sub, share_cap)
                 else:
-                    sv = jnp.zeros((share_cap,), ev["reuse"].dtype)
+                    sv = jnp.zeros((share_cap,), reuse.dtype)
                     sc = jnp.zeros((share_cap,), jnp.int32)
                     snu = jnp.int32(0)
                 return (last_pos, hist), (sv, sc, snu)
         else:
-            fast_step = None
+            ultra_step = None
 
-        # windows processed in order as (fast | sort) segments: a window takes
-        # the gather path only when it is clean for EVERY thread (vmap runs
-        # threads in lockstep)
-        fast_w = (
+        # windows processed in order as (ultra | sort) segments: a window
+        # takes the static-template path only when it is clean for EVERY
+        # thread (vmap runs threads in lockstep)
+        ultra_w = (
             np_.clean.all(axis=0)
-            if fast_step is not None
+            if ultra_step is not None
             else np.zeros(np_.n_windows, bool)
         )
         segments: list[tuple[bool, list[int]]] = []
         for w in range(np_.n_windows):
-            r0 = w * np_.window_rounds
-            if segments and segments[-1][0] == bool(fast_w[w]):
-                segments[-1][1].append(r0)
+            if segments and segments[-1][0] == bool(ultra_w[w]):
+                segments[-1][1].append(w)
             else:
-                segments.append((bool(fast_w[w]), [r0]))
+                segments.append((bool(ultra_w[w]), [w]))
 
         ys_parts = []
-        for is_fast, r0_list in segments:
-            body = fast_step if is_fast else sort_step
-            if len(r0_list) == 1:
+        for is_ultra, w_list in segments:
+            body = ultra_step if is_ultra else sort_step
+            if len(w_list) == 1:
                 (last_pos, hist), ys = body(
-                    (last_pos, hist), jnp.int32(r0_list[0])
+                    (last_pos, hist), jnp.int32(w_list[0])
                 )
                 ys = jax.tree.map(lambda a: a[None], ys)
             else:
                 (last_pos, hist), ys = jax.lax.scan(
                     body, (last_pos, hist),
-                    jnp.asarray(r0_list, jnp.int32),
+                    jnp.asarray(w_list, jnp.int32),
                 )
             ys_parts.append(ys)
         ys = (
@@ -434,25 +512,60 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
     return hist, share_ys
 
 
+def _thread_pipeline_packed(tid, pl: StreamPlan, share_cap: int):
+    """One flat per-thread result vector: device->host traffic is ONE array.
+
+    Every host read of a device array is a full round trip (expensive over a
+    tunneled TPU), so the histogram and all per-window share outputs are
+    concatenated on device; :func:`_unpack` slices them back on the host.
+    """
+    hist, share_ys = _thread_pipeline(tid, pl, share_cap)
+    pdt = jnp.dtype(pl.pos_dtype)
+    parts = [hist.astype(pdt).ravel()]
+    for sv, sc, snu in share_ys:
+        parts += [sv.astype(pdt).ravel(), sc.astype(pdt).ravel(),
+                  snu.astype(pdt).ravel()]
+    return jnp.concatenate(parts)
+
+
+def _unpack(flat: np.ndarray, pl: StreamPlan, share_cap: int):
+    """Host-side inverse of :func:`_thread_pipeline_packed` over [T, L]."""
+    T = flat.shape[0]
+    hist = flat[:, :NBINS]
+    off = NBINS
+    share_ys = []
+    for n in pl.nests:
+        NW = n.n_windows
+        sv = flat[:, off:off + NW * share_cap].reshape(T, NW, share_cap)
+        off += NW * share_cap
+        sc = flat[:, off:off + NW * share_cap].reshape(T, NW, share_cap)
+        off += NW * share_cap
+        snu = flat[:, off:off + NW].reshape(T, NW)
+        off += NW
+        share_ys.append((sv, sc, snu))
+    assert off == flat.shape[1]
+    return hist, share_ys
+
+
 @functools.lru_cache(maxsize=None)
 def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
              assignment=None, start_point=None, window_accesses=None,
              backend: str = "vmap"):
     """(plan, jitted fn) for a workload; cached so repeat runs reuse the XLA
     executable (the reference's `speed` mode re-runs the same sampler 3x,
-    main.rs:23-35)."""
+    main.rs:23-35).  The jitted fn returns the packed [T, L] result matrix."""
     pl = plan(spec, cfg, assignment, start_point, window_accesses)
 
     if backend == "vmap":
         def f(tids):
-            return jax.vmap(lambda t: _thread_pipeline(t, pl, share_cap))(tids)
+            return jax.vmap(
+                lambda t: _thread_pipeline_packed(t, pl, share_cap))(tids)
         return pl, jax.jit(f)
     if backend == "seq":
-        one = jax.jit(lambda t: _thread_pipeline(t, pl, share_cap))
+        one = jax.jit(lambda t: _thread_pipeline_packed(t, pl, share_cap))
 
         def f(tids):
-            outs = [one(t) for t in tids]
-            return jax.tree.map(lambda *xs: jnp.stack(xs), *outs)
+            return jnp.stack([one(t) for t in tids])
         return pl, f
     raise ValueError(f"unknown backend {backend!r} (expected 'vmap' or 'seq')")
 
@@ -536,12 +649,26 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     pl, f = compiled(spec, cfg, share_cap, assignment, start_point,
                      window_accesses, backend)
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
-    hist, share_ys = f(tids)
+    hist, share_ys = _unpack(np.asarray(f(tids)), pl, share_cap)
     # share_ys: per nest (svals [T, NW, cap], scnts, snu [T, NW])
     share_raw = merge_share_windows(
         [y[0] for y in share_ys], [y[1] for y in share_ys],
         [y[2] for y in share_ys], share_cap, cfg.thread_num,
     )
+    # static in-window share events of ultra windows are host-side constants:
+    # identical values and counts for every clean window of every thread
+    for np_ in pl.nests:
+        if np_.tpl is None or not np_.tpl.share_vals.size:
+            continue
+        n_ultra = int(np_.clean.all(axis=0).sum())
+        if not n_ultra:
+            continue
+        pairs = list(zip(np_.tpl.share_vals.tolist(),
+                         (np_.tpl.share_cnts * n_ultra).tolist()))
+        for t in range(cfg.thread_num):
+            d = share_raw[t]
+            for v, c in pairs:
+                d[v] = d.get(v, 0) + c
     return SamplerResult(
         noshare_dense=np.asarray(hist, np.int64),
         share_raw=share_raw,

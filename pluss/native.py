@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import subprocess
 
 import numpy as np
@@ -54,7 +55,11 @@ def available(autobuild: bool = False) -> bool:
         try:
             build()
         except FileNotFoundError:
-            pass  # no toolchain — a prebuilt lib may still exist
+            pass  # no make — a prebuilt lib may still exist
+        except RuntimeError:
+            if shutil.which("g++") is not None:
+                raise  # real compile failure with a working toolchain
+            # make without g++: same no-toolchain fallback as missing make
     return os.path.exists(LIB_PATH)
 
 

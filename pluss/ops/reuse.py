@@ -33,6 +33,18 @@ from pluss.config import NBINS
 LINE_SENTINEL = np.int32(2**31 - 1)
 
 
+def share_mask(reuse, span):
+    """Cross-thread classification: ``distance_to(reuse,0) >
+    distance_to(reuse,span)`` (gemm_sampler.rs:199), i.e. ``2*reuse > span``.
+
+    Written division-sided — ``reuse > span//2`` (equivalent for ints of
+    either parity) — so a reuse near the int32 clock ceiling cannot overflow;
+    the engine's pos-dtype threshold (engine.plan) relies on every share test
+    going through this helper.  Works on numpy and jnp arrays alike.
+    """
+    return (span > 0) & (reuse > (span // 2).astype(reuse.dtype))
+
+
 def log2_bin(reuse: jnp.ndarray) -> jnp.ndarray:
     """Slot index of the reference's log2 binning: reuse in [2^e, 2^{e+1}) -> 1+e.
 
@@ -70,7 +82,8 @@ def window_events(key_s, pos_s, span_s, valid_i, last_pos):
       is_evt: a reuse interval was observed
       share:  reuse classified cross-thread by the reference's
               ``distance_to(reuse,0) > distance_to(reuse,span)`` test — exactly
-              ``2*reuse > span`` for integers (gemm_sampler.rs:199)
+              ``2*reuse > span``, i.e. ``reuse > span//2``, for integers
+              (gemm_sampler.rs:199)
       cold:   first *global* touch of a line (contributes to the cold key -1)
       head:   first in-window touch of a line
       tail:   last in-window touch of a line
@@ -103,7 +116,7 @@ def window_events(key_s, pos_s, span_s, valid_i, last_pos):
         is_evt = local_evt
         new_last_pos = None
 
-    share = is_evt & (span_s > 0) & (2 * reuse > span_s)
+    share = is_evt & share_mask(reuse, span_s)
     return {
         "reuse": reuse.astype(pos_s.dtype),
         "is_evt": is_evt,

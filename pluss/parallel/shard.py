@@ -45,6 +45,7 @@ from pluss.ops.reuse import (
     boundary_arrays,
     event_histogram,
     log2_bin,
+    share_mask,
     share_unique,
     window_events,
 )
@@ -113,7 +114,7 @@ def _shard_body(tids, pl: StreamPlan, share_cap: int, D: int):
     head_evt = has_head & (prev >= 0)
     cold = has_head & (prev < 0)
     reuse = jnp.where(head_evt, head_pos - prev, 0)
-    share = head_evt & (head_span > 0) & (2 * reuse > head_span)
+    share = head_evt & share_mask(reuse, head_span)
     nevt = head_evt & ~share
     bins = jnp.where(nevt, log2_bin(reuse), 0)
     w = (cold | nevt).astype(hist.dtype)

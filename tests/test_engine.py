@@ -60,6 +60,14 @@ def test_windowed_scan_matches_single_window():
     assert win.share_raw == full.share_raw
 
 
+def test_repeat_runs_identical():
+    # per-run state (Q1 fixed): a second run must not accumulate anything
+    a = run(gemm(16))
+    b = run(gemm(16))
+    assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
+    assert a.share_raw == b.share_raw
+
+
 def test_seq_backend_matches_vmap():
     cfg = SamplerConfig(cls=8)
     a = run(gemm(12), cfg)
@@ -113,25 +121,33 @@ def test_gemm128_matches_golden():
     assert share == GOLD_SHARE_128
 
 
+def test_mixed_ultra_sort_segments_matches_oracle():
+    # trip 24 over 4 threads: 6 chunks -> threads 2,3 idle in round 2, so
+    # window 1 is unclean: an ultra segment (w0) hands the last_pos carry to
+    # a sort segment (w1); every histogram must still match the oracle
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(gemm(24), cfg, window_accesses=1)
+
+
 def test_static_perm_eligibility():
     """Fast (host-permutation) path activates exactly where the
     shift-invariance conditions hold."""
     from pluss.engine import plan
     from pluss.models import REGISTRY
 
-    assert plan(gemm(16)).nests[0].perm is not None
+    assert plan(gemm(16)).nests[0].tpl is not None
     # syrk reads A with two different parallel-dim coefficients -> sort path
-    assert plan(REGISTRY["syrk"](16)).nests[0].perm is None
+    assert plan(REGISTRY["syrk"](16)).nests[0].tpl is None
     # odd N: per-chunk shift not a whole number of cache lines -> sort path
-    assert plan(gemm(13)).nests[0].perm is None
+    assert plan(gemm(13)).nests[0].tpl is None
     # custom assignment breaks the linear cid progression -> sort path
-    assert plan(gemm(16), assignment=((0, 1, 2, 3),)).nests[0].perm is None
+    assert plan(gemm(16), assignment=((0, 1, 2, 3),)).nests[0].tpl is None
 
 
 def test_fast_path_matches_sort_path():
-    """Force multi-window so fast (gather) and sort bodies both execute and
-    the carried last_pos hands off between them; compare against the default
-    plan and the oracle-backed goldens via run()."""
+    """Force multi-window so ultra (static-template) and sort bodies both
+    execute and the carried last_pos hands off between them; compare against
+    the default plan and the oracle-backed goldens via run()."""
     spec = gemm(32)
     base = run(spec)
     small_windows = run(spec, window_accesses=4096)  # several windows
