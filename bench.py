@@ -88,7 +88,8 @@ def main() -> int:
         n, metric = 128, "gemm128_sampler_refs_per_sec_cpu_fallback"
         log("bench: running CPU fallback at N=128")
     else:
-        n, metric = 512, "gemm512_sampler_refs_per_sec"
+        # BASELINE.json config 2: GEMM 1024^3 speed mode (4.3e9 refs/run)
+        n, metric = 1024, "gemm1024_sampler_refs_per_sec"
         log(f"bench: accelerator platform {plat!r}, N={n}")
 
     from pluss import cri, engine

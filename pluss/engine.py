@@ -40,6 +40,7 @@ import numpy as np
 
 from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
 from pluss.ops.reuse import (
+    bin_histogram,
     event_histogram,
     log2_bin,
     share_mask,
@@ -91,6 +92,12 @@ class WindowTemplate:
     tail_line: np.ndarray     # [Ht] int32 last-touch line ids at the origin
     tail_pos: np.ndarray      # [Ht]
     tail_dline: np.ndarray    # [Ht] int32
+    # contiguous-run views of the sorted head/tail line sets, or None when
+    # too fragmented: each row is (line_start, offset, length, dline).  TPUs
+    # serialize dynamic-index gathers/scatters, so piecewise-contiguous sets
+    # (the common affine case) instead use one dynamic_slice per run.
+    head_runs: np.ndarray | None = None   # [R, 4] int64
+    tail_runs: np.ndarray | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -222,6 +229,27 @@ def _clean_windows(owned: np.ndarray, W: int, NW: int, CS: int,
     return (cids >= 0).all(axis=2) & (cids.max(axis=2) * CS + CS <= trip)
 
 
+def _line_runs(lines: np.ndarray, dline: np.ndarray,
+               max_runs: int = 64) -> np.ndarray | None:
+    """Maximal (consecutive-line, constant-shift) runs of a sorted line set.
+
+    Returns [R, 4] rows (line_start, offset, length, dline), or None when the
+    set fragments into more than ``max_runs`` pieces (then the dynamic-index
+    gather/scatter path is used instead).
+    """
+    n = len(lines)
+    if n == 0:
+        return np.zeros((0, 4), np.int64)
+    brk = np.nonzero((np.diff(lines) != 1) | (np.diff(dline) != 0))[0] + 1
+    if len(brk) + 1 > max_runs:
+        return None
+    starts = np.concatenate([[0], brk])
+    ends = np.concatenate([brk, [n]])
+    return np.stack(
+        [lines[starts], starts, ends - starts, dline[starts]], axis=1
+    ).astype(np.int64)
+
+
 def _build_template(refs, W, cfg, sched, owned, clean, bases, array_index,
                     body: int) -> WindowTemplate | None:
     """Analyze the first clean window on the host; None if no window is clean."""
@@ -262,6 +290,10 @@ def _build_template(refs, W, cfg, sched, owned, clean, bases, array_index,
     local_hist = np.bincount(slots, minlength=NBINS).astype(np.int64)
     share_vals, share_cnts = np.unique(reuse[share], return_counts=True)
     head_span = span[headm]
+    head_line = line[headm].astype(np.int32)
+    head_dline = dline[headm]
+    tail_line = line[tailm].astype(np.int32)
+    tail_dline = dline[tailm]
     return WindowTemplate(
         t0=t0,
         w0=w0,
@@ -270,14 +302,16 @@ def _build_template(refs, W, cfg, sched, owned, clean, bases, array_index,
         local_hist=local_hist,
         share_vals=share_vals.astype(np.int64),
         share_cnts=share_cnts.astype(np.int64),
-        head_line=line[headm].astype(np.int32),
+        head_line=head_line,
         head_pos=pos[headm],
         head_span=head_span,
-        head_dline=dline[headm],
+        head_dline=head_dline,
         hs_idx=np.nonzero(head_span > 0)[0].astype(np.int32),
-        tail_line=line[tailm].astype(np.int32),
+        tail_line=tail_line,
         tail_pos=pos[tailm],
-        tail_dline=dline[tailm],
+        tail_dline=tail_dline,
+        head_runs=_line_runs(head_line, head_dline),
+        tail_runs=_line_runs(tail_line, tail_dline),
     )
 
 
@@ -451,16 +485,31 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                 last_pos, hist = carry
                 units = (w - tpl.w0) * tpl.unit_w + units0
                 dpos = (w - tpl.w0).astype(pdt) * shift_w + nb
-                carried = last_pos[hline + hdl * units]
+                if tpl.head_runs is not None:
+                    carried = jnp.concatenate([
+                        jax.lax.dynamic_slice(
+                            last_pos, (int(ls) + int(dl) * units,), (int(ln),)
+                        )
+                        for ls, _, ln, dl in tpl.head_runs
+                    ]) if len(tpl.head_runs) else last_pos[:0]
+                else:
+                    carried = last_pos[hline + hdl * units]
                 cold = carried < 0
                 reuse = (hpos + dpos) - carried
                 share = ~cold & share_mask(reuse, hspan)
                 evt = ~cold & ~share
                 bins = jnp.where(evt, log2_bin(reuse), 0)
                 wgt = (cold | evt).astype(pdt)
-                hist = hist + lhist + jax.ops.segment_sum(
-                    wgt, bins, num_segments=NBINS)
-                last_pos = last_pos.at[tline + tdl * units].set(tpos + dpos)
+                hist = hist + lhist + bin_histogram(bins, wgt)
+                newv = tpos + dpos
+                if tpl.tail_runs is not None:
+                    for ls, off, ln, dl in tpl.tail_runs:
+                        last_pos = jax.lax.dynamic_update_slice(
+                            last_pos, newv[int(off):int(off) + int(ln)],
+                            (int(ls) + int(dl) * units,),
+                        )
+                else:
+                    last_pos = last_pos.at[tline + tdl * units].set(newv)
                 if tpl.hs_idx.shape[0]:
                     sub = {"reuse": reuse[hs_idx], "share": share[hs_idx]}
                     sv, sc, snu = share_unique(sub, share_cap)

@@ -127,6 +127,23 @@ def window_events(key_s, pos_s, span_s, valid_i, last_pos):
     }, new_last_pos
 
 
+def bin_histogram(bins: jnp.ndarray, wgt: jnp.ndarray,
+                  num_segments: int = NBINS) -> jnp.ndarray:
+    """[num_segments] histogram of 0/1 weights — one-hot matmul on the MXU.
+
+    TPUs serialize dynamic-index scatters, so ``segment_sum`` over a window is
+    orders of magnitude slower than a [1, n] x [n, num_segments] matmul.  f32
+    accumulation is exact while a window holds < 2^24 events; the engine's
+    window sizes guarantee that (engine.WINDOW_TARGET).
+    """
+    n = bins.shape[0]
+    if n >= 1 << 24:  # f32 mantissa bound; fall back to the exact scatter
+        return jax.ops.segment_sum(wgt, bins, num_segments=num_segments)
+    oh = bins[:, None] == jnp.arange(num_segments, dtype=bins.dtype)[None, :]
+    out = wgt.astype(jnp.float32)[None, :] @ oh.astype(jnp.float32)
+    return out[0].astype(wgt.dtype)
+
+
 def event_histogram(ev: dict) -> jnp.ndarray:
     """[NBINS] dense histogram of one window: slot 0 = cold (-1), slot 1+e = 2^e.
 
@@ -136,7 +153,7 @@ def event_histogram(ev: dict) -> jnp.ndarray:
     evt = ev["is_evt"] & ~ev["share"]
     bins = jnp.where(evt, log2_bin(ev["reuse"]), 0)
     w = (ev["cold"] | evt).astype(ev["reuse"].dtype)
-    return jax.ops.segment_sum(w, bins, num_segments=NBINS)
+    return bin_histogram(bins, w)
 
 
 def boundary_arrays(key_s, pos_s, span_s, ev: dict, n_lines: int):
@@ -175,11 +192,13 @@ def share_unique(ev: dict, cap: int):
     boundary = jnp.concatenate([is_evt[:1], (sv[1:] != sv[:-1]) & is_evt[1:]])
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     seg = jnp.where(is_evt, seg, cap)  # padding -> overflow slot
-    counts = jax.ops.segment_sum(
-        is_evt.astype(jnp.int32), seg, num_segments=cap + 1
-    )[:cap]
-    vals = jnp.zeros((cap + 1,), sv.dtype).at[seg].set(
-        jnp.where(is_evt, sv, 0), mode="drop"
-    )[:cap]
+    counts = bin_histogram(seg, is_evt.astype(jnp.int32), cap + 1)[:cap]
+    # segment b's value sits at the start offset of its sorted run — a
+    # cap-sized gather instead of a stream-sized scatter (serialized on TPU)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]]
+    )
+    n = sv.shape[0]
+    vals = jnp.where(counts > 0, sv[jnp.minimum(starts, n - 1)], 0)
     n_unique = boundary.sum().astype(jnp.int32)
     return vals, counts, n_unique

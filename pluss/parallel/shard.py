@@ -42,6 +42,7 @@ from pluss.engine import (
     window_stream,
 )
 from pluss.ops.reuse import (
+    bin_histogram,
     boundary_arrays,
     event_histogram,
     log2_bin,
@@ -119,8 +120,7 @@ def _shard_body(tids, pl: StreamPlan, share_cap: int, D: int):
     bins = jnp.where(nevt, log2_bin(reuse), 0)
     w = (cold | nevt).astype(hist.dtype)
     head_hist = jax.vmap(
-        lambda bb, ww: jax.ops.segment_sum(ww.ravel(), bb.ravel(),
-                                           num_segments=NBINS)
+        lambda bb, ww: bin_histogram(bb.ravel(), ww.ravel())
     )(bins, w)
     total = hist.sum(axis=1) + head_hist            # [T, NBINS]
     total = jax.lax.psum(total, "d")                # replicated merge over ICI
