@@ -6,6 +6,7 @@ reference's only shipped workload; the others cover the BASELINE.json configs
 """
 
 from pluss.models.gemm import gemm
+from pluss.models.linalg import atax, bicg, doitgen, gesummv, jacobi2d, mvt
 from pluss.models.polybench import mm2, mm3, syrk
 from pluss.models.stencils import conv2d, stencil3d
 
@@ -16,6 +17,15 @@ REGISTRY = {
     "syrk": syrk,
     "conv2d": conv2d,
     "stencil3d": stencil3d,
+    "atax": atax,
+    "mvt": mvt,
+    "bicg": bicg,
+    "gesummv": gesummv,
+    "doitgen": doitgen,
+    "jacobi2d": jacobi2d,
 }
 
-__all__ = ["gemm", "mm2", "mm3", "syrk", "conv2d", "stencil3d", "REGISTRY"]
+__all__ = [
+    "gemm", "mm2", "mm3", "syrk", "conv2d", "stencil3d",
+    "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d", "REGISTRY",
+]

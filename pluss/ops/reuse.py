@@ -56,13 +56,21 @@ def log2_bin(reuse: jnp.ndarray) -> jnp.ndarray:
     return (1 + e).astype(jnp.int32)
 
 
-def sort_stream(line, pos, span, valid):
+def sort_stream(line, pos, span, valid, pos_sorted: bool = False):
     """Sort one stream window by (line, position); invalid entries sort last.
+
+    ``pos_sorted``: pass True when the inputs are already in ascending
+    position order (e.g. a replayed trace window) — then a *stable* sort on
+    the line key alone preserves position order at half the comparator cost.
 
     Returns (key_s, pos_s, span_s, valid_s[int32]).
     """
     key = jnp.where(valid, line, LINE_SENTINEL)
-    return jax.lax.sort((key, pos, span, valid.astype(jnp.int32)), num_keys=2)
+    return jax.lax.sort(
+        (key, pos, span, valid.astype(jnp.int32)),
+        num_keys=1 if pos_sorted else 2,
+        is_stable=pos_sorted,
+    )
 
 
 def window_events(key_s, pos_s, span_s, valid_i, last_pos):
@@ -100,16 +108,24 @@ def window_events(key_s, pos_s, span_s, valid_i, last_pos):
 
     if last_pos is not None:
         n_lines = last_pos.shape[0]
-        safe_key = jnp.where(valid_b, key_s, 0)
-        carried = last_pos[safe_key]
+        w = key_s.shape[0]
+        # clipping (not masking to 0) keeps the gather indices sorted — the
+        # sentinel-keyed invalid tail clips to n_lines-1; results are masked
+        # by `head` (valid-only) downstream
+        safe_key = jnp.minimum(key_s, n_lines - 1)
+        carried = last_pos.at[safe_key].get(indices_are_sorted=True)
         head_evt = head & (carried >= 0)
         cold = head & (carried < 0)
         reuse = jnp.where(
             local_evt, pos_s - prev_pos, jnp.where(head_evt, pos_s - carried, 0)
         )
         is_evt = local_evt | head_evt
-        tgt = jnp.where(tail, key_s, n_lines)
-        new_last_pos = last_pos.at[tgt].set(pos_s, mode="drop")
+        # non-tails scatter into private dump slots past n_lines so the
+        # update is a true permutation (unique_indices lets XLA vectorize
+        # what a shared dump slot would serialize)
+        tgt = jnp.where(tail, key_s, n_lines + jnp.arange(w, dtype=key_s.dtype))
+        ext = jnp.concatenate([last_pos, jnp.zeros((w,), last_pos.dtype)])
+        new_last_pos = ext.at[tgt].set(pos_s, unique_indices=True)[:n_lines]
     else:
         cold = jnp.zeros_like(head)
         reuse = jnp.where(local_evt, pos_s - prev_pos, 0)

@@ -36,8 +36,9 @@ import numpy as np
 from pluss.config import NBINS
 from pluss.ops.reuse import event_histogram, sort_stream, window_events
 
-#: default accesses per device window
-TRACE_WINDOW = 1 << 22
+#: default accesses per device window; 2^20 wins the sort-cost vs
+#: scan-step-count tradeoff on TPU (measured 2026-07-30)
+TRACE_WINDOW = 1 << 20
 
 
 def lines_of(addrs: np.ndarray, cls: int = 64) -> np.ndarray:
@@ -69,14 +70,20 @@ class ReplayResult:
         return out
 
 
+#: windows shipped to the device per batch; one compile serves a trace of any
+#: length because every batch has the same [WINDOWS_PER_BATCH, window] shape
+WINDOWS_PER_BATCH = 8
+
+
 @functools.lru_cache(maxsize=None)
-def _replay_fn(n_windows: int, window: int, n_lines: int, pos_dtype_name: str):
+def _replay_fn(window: int, n_lines: int, pos_dtype_name: str):
     pdt = jnp.dtype(pos_dtype_name)
 
-    def run(ids: jnp.ndarray, valid: jnp.ndarray):
-        # ids, valid: [n_windows, window]
+    def run(last_pos, hist, base, ids, valid):
+        # ids, valid: [WINDOWS_PER_BATCH, window]; base: batch stream offset
         pos = (
-            jnp.arange(n_windows, dtype=pdt)[:, None] * window
+            base
+            + jnp.arange(WINDOWS_PER_BATCH, dtype=pdt)[:, None] * window
             + jnp.arange(window, dtype=pdt)[None, :]
         )
 
@@ -84,19 +91,20 @@ def _replay_fn(n_windows: int, window: int, n_lines: int, pos_dtype_name: str):
             last_pos, hist = carry
             line_w, pos_w, valid_w = xs
             span = jnp.zeros_like(line_w)
+            # trace windows arrive in stream order: stable single-key sort
             ev, last_pos = window_events(
-                *sort_stream(line_w, pos_w, span, valid_w), last_pos
+                *sort_stream(line_w, pos_w, span, valid_w, pos_sorted=True),
+                last_pos,
             )
             return (last_pos, hist + event_histogram(ev)), None
 
-        init = (jnp.full((n_lines,), -1, pdt), jnp.zeros((NBINS,), pdt))
-        (last_pos, hist), _ = jax.lax.scan(step, init, (ids, pos, valid))
-        return hist
+        (last_pos, hist), _ = jax.lax.scan(
+            step, (last_pos, hist), (ids, pos, valid)
+        )
+        return last_pos, hist
 
-    # buffer donation frees the id stream as it is consumed (it is the large
-    # input at 1e9 refs); unsupported (and warning-noisy) on the CPU backend
-    donate = () if jax.default_backend() == "cpu" else (0,)
-    return jax.jit(run, donate_argnums=donate)
+    # donating the carry keeps last_pos/hist in place on device across batches
+    return jax.jit(run, donate_argnums=(0, 1))
 
 
 def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
@@ -140,24 +148,30 @@ def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
         ids[lo:lo + window] = ids_sorted[np.searchsorted(keys_sorted, chunk)]
     n_lines = next_id
 
-    n_windows = -(-n // window)
-    pad = n_windows * window - n
-    if pad:
-        ids_p = np.concatenate([ids, np.zeros(pad, np.int32)])
-    else:
-        ids_p = ids
-    valid = np.ones(n_windows * window, bool)
-    valid[n:] = False
-    pos_dtype = "int32" if n_windows * window < 2**30 else "int64"
+    batch = WINDOWS_PER_BATCH * window
+    n_batches = -(-n // batch)
+    pos_dtype = "int32" if n_batches * batch < 2**31 - 2 else "int64"
     if pos_dtype == "int64" and not jax.config.jax_enable_x64:
         raise RuntimeError(
             f"trace of {n} accesses needs int64 positions; enable jax_enable_x64"
         )
-    fn = _replay_fn(n_windows, window, n_lines, pos_dtype)
-    hist = fn(
-        jnp.asarray(ids_p.reshape(n_windows, window)),
-        jnp.asarray(valid.reshape(n_windows, window)),
-    )
+    fn = _replay_fn(window, n_lines, pos_dtype)
+    pdt = np.dtype(pos_dtype)
+    last_pos = jnp.full((n_lines,), -1, pdt)
+    hist = jnp.zeros((NBINS,), pdt)
+    for b in range(n_batches):
+        lo = b * batch
+        chunk = ids[lo:lo + batch]
+        pad = batch - len(chunk)
+        valid = np.ones(batch, bool)
+        if pad:
+            chunk = np.concatenate([chunk, np.zeros(pad, np.int32)])
+            valid[len(chunk) - pad:] = False
+        last_pos, hist = fn(
+            last_pos, hist, pdt.type(lo),
+            jnp.asarray(chunk.reshape(WINDOWS_PER_BATCH, window)),
+            jnp.asarray(valid.reshape(WINDOWS_PER_BATCH, window)),
+        )
     return ReplayResult(np.asarray(hist, np.int64), n, n_lines)
 
 

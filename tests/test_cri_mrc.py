@@ -100,3 +100,22 @@ def test_l2_error():
     a = np.array([1.0, 0.5, 0.25])
     assert mrc.l2_error(a, a) == 0.0
     assert mrc.l2_error(a, np.zeros(3)) > 0
+
+
+def test_north_star_mrc_vs_native_gemm128():
+    """BASELINE.json acceptance: reproduce the C++ GEMM-128 miss-ratio curve
+    within 1% L2 error (the full engine -> CRI -> AET pipeline against the
+    native C++ runtime's own pipeline)."""
+    from pluss import engine, native
+    from pluss.models import gemm
+
+    if not native.available(autobuild=True):
+        pytest.skip("native toolchain unavailable")
+    res = engine.run(gemm(128))
+    ri = cri.distribute(res.noshare_list(), res.share_list(),
+                        DEFAULT.thread_num)
+    ours = mrc.aet_mrc(ri)
+    theirs = native.run(gemm(128)).mrc()
+    assert len(ours) == len(theirs)
+    err = mrc.l2_error(ours, theirs)
+    assert err < 0.01, f"MRC L2 error {err:.2e} vs north-star bar 1%"

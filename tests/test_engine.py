@@ -41,9 +41,22 @@ def test_gemm_odd_size_matches_oracle(cfg):
     assert_matches_oracle(gemm(13), cfg)
 
 
-@pytest.mark.parametrize("name", ["2mm", "3mm", "syrk", "conv2d"])
+@pytest.mark.parametrize(
+    "name",
+    ["2mm", "3mm", "syrk", "conv2d", "atax", "mvt", "bicg", "gesummv"],
+)
 def test_other_kernels_match_oracle(name):
     assert_matches_oracle(REGISTRY[name](12), SamplerConfig(cls=8))
+
+
+def test_doitgen_matches_oracle():
+    assert_matches_oracle(REGISTRY["doitgen"](6), SamplerConfig(cls=8))
+
+
+def test_jacobi2d_matches_oracle():
+    # 4 alternating nests (2 timesteps): LAT state and clocks persist across
+    # nests, so reuse crosses sweep boundaries
+    assert_matches_oracle(REGISTRY["jacobi2d"](10), SamplerConfig(cls=8))
 
 
 def test_stencil3d_matches_oracle():
