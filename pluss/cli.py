@@ -15,8 +15,10 @@ Backends mirror the reference's trio (rayon / spawn / seq) as:
 ``vmap`` (simulated threads as a vmap axis), ``shard`` (stream windows over the
 device mesh, :mod:`pluss.parallel.shard`), ``seq`` (one thread at a time).
 
-Extra subcommand ``mrc`` exposes the reference's dormant titular capability
-(AET -> miss-ratio curve, pluss_utils.h:758-804) as a live, tested path.
+Extra subcommands: ``mrc`` exposes the reference's dormant titular capability
+(AET -> miss-ratio curve, pluss_utils.h:758-804) as a live, tested path;
+``trace`` replays a raw address file through :mod:`pluss.trace` (the
+reference's disabled ``pluss_access`` dynamic path, BASELINE config 5).
 
 The timed region matches the reference: ``sampler() + pluss_cri_distribute``
 (…omp.cpp:337-339).  Compilation is excluded by a warmup call — the analogue of
@@ -57,9 +59,18 @@ def _sampler_of(backend: str, spec, cfg: SamplerConfig, share_cap: int):
     return step
 
 
-def _timed(step):
+def _timed(step, profile_dir: str | None = None):
     """Time one (sampler + distribute) step — the reference's timed region
-    (…omp.cpp:337-339)."""
+    (…omp.cpp:337-339).  ``profile_dir`` wraps the step in a jax profiler
+    trace (the observability hook the reference's DEBUG prints stand in for)."""
+    if profile_dir:
+        import jax
+
+        with jax.profiler.trace(profile_dir):
+            t0 = time.perf_counter()
+            res, ri = step()
+            dt = time.perf_counter() - t0
+        return dt, res, ri
     t0 = time.perf_counter()
     res, ri = step()
     return time.perf_counter() - t0, res, ri
@@ -71,7 +82,10 @@ def banner_of(backend: str) -> str:
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="pluss", description=__doc__)
-    p.add_argument("mode", choices=("acc", "speed", "mrc"))
+    p.add_argument("mode", choices=("acc", "speed", "mrc", "trace"))
+    p.add_argument("--file", help="trace-mode input file of raw addresses")
+    p.add_argument("--fmt", default="u64", choices=("u64", "text"),
+                   help="trace file format (packed LE uint64 | text)")
     p.add_argument("--model", default="gemm", choices=sorted(REGISTRY))
     p.add_argument("--n", type=int, default=128, help="problem size")
     p.add_argument("--backends", default="vmap,shard,seq",
@@ -83,6 +97,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default="mrc.csv", help="mrc-mode output file")
     p.add_argument("--cpu", action="store_true",
                    help="force the host CPU backend (8 virtual devices)")
+    p.add_argument("--profile", metavar="DIR",
+                   help="write a jax profiler trace of the timed region to "
+                        "DIR (view with tensorboard or xprof)")
     args = p.parse_args(argv)
 
     if args.cpu:
@@ -102,7 +119,7 @@ def main(argv: list[str] | None = None) -> int:
         for b in backends:
             step = _sampler_of(b, spec, cfg, args.share_cap)
             step()  # warmup: exclude compilation from the timed region
-            dt, res, ri = _timed(step)
+            dt, res, ri = _timed(step, args.profile)
             acc_block(banner_of(b), dt, res.noshare_list(), res.share_list(),
                       ri, res.max_iteration_count, out)
     elif args.mode == "speed":
@@ -111,13 +128,30 @@ def main(argv: list[str] | None = None) -> int:
             step()  # warmup once per backend
             times = [_timed(step)[0] for _ in range(args.reps)]
             speed_block(banner_of(b), times, out)
-    else:  # mrc
+    elif args.mode == "mrc":
         step = _sampler_of(backends[0], spec, cfg, args.share_cap)
-        _, res, ri = _timed(step)
+        _, res, ri = _timed(step, args.profile)
         curve = mrc.aet_mrc(ri, cfg)
         mrc.write_mrc(args.out, curve)
         out.write(f"wrote {len(mrc.dedup_lines(curve))} MRC lines to "
                   f"{args.out} (curve over {len(curve)} cache sizes)\n")
+    else:  # trace: dynamic replay (BASELINE config 5; bypasses CRI like the
+        # reference's pluss_access path — see pluss/trace.py)
+        if not args.file:
+            p.error("trace mode requires --file")
+        from pluss import trace as trace_mod
+        from pluss.io import print_histogram
+
+        addrs = trace_mod.load_trace(args.file, args.fmt)
+        t0 = time.perf_counter()
+        rep = trace_mod.replay(addrs, cls=cfg.cls)
+        dt = time.perf_counter() - t0
+        out.write(f"TPU TRACE: {dt:0.6f}\n")
+        print_histogram("Start to dump reuse time", rep.histogram(), out)
+        curve = mrc.aet_mrc(rep.histogram(), cfg)
+        mrc.write_mrc(args.out, curve)
+        out.write(f"{rep.total_count} refs over {rep.n_lines} lines; "
+                  f"wrote MRC to {args.out}\n")
     return 0
 
 

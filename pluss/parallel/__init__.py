@@ -8,4 +8,4 @@ sharded over devices with ``shard_map``, boundary state is exchanged with one
 
 from pluss.parallel.shard import default_mesh, shard_run
 
-__all__ = ["default_mesh", "shard_run"]
+__all__ = ["default_mesh", "shard_run", "multihost"]

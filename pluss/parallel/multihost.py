@@ -1,0 +1,59 @@
+"""Multi-host (multi-process) execution over DCN — the distributed backend.
+
+The reference has no communication backend at all (shared memory + locks,
+SURVEY.md §2/§5); this framework's cross-device story is XLA collectives, which
+makes multi-host support a *configuration* problem rather than a code path:
+:func:`pluss.parallel.shard.shard_run` only uses ``all_gather`` and ``psum``,
+both of which XLA routes over ICI within a slice and DCN across hosts, with no
+point-to-point communication anywhere.  This module provides the standard
+JAX multi-process bring-up around it.
+
+Usage (one process per host, e.g. under SLURM/GKE or manual bring-up)::
+
+    from pluss.parallel.multihost import initialize, global_mesh
+    initialize(coordinator_address="host0:1234", num_processes=4, process_id=i)
+    mesh = global_mesh()                      # 1-D mesh over ALL devices
+    res = shard_run(gemm(1024), mesh=mesh)    # same call as single-host
+
+Single-host callers never need this module (``default_mesh()`` covers them).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """``jax.distributed.initialize`` pass-through.
+
+    With no arguments, JAX auto-detects the cluster environment (TPU pod
+    metadata, SLURM, GKE); explicit arguments cover manual bring-up.  Safe to
+    call once per process, before any other JAX API touches a backend.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis: str = "d") -> Mesh:
+    """1-D mesh over every device of every participating process.
+
+    ``shard_run`` shards stream windows over this axis; each process feeds
+    the same (replicated) inputs, per JAX's multi-process SPMD model.
+    """
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the process that should own printing/IO (process 0)."""
+    return jax.process_index() == 0

@@ -107,3 +107,20 @@ def test_merge_share_raw_keys(gemm16):
 def test_merge_noshare_has_cold_key(gemm16):
     res, _ = gemm16
     assert -1 in merge_noshare(res.noshare_list())
+
+
+def test_trace_mode(tmp_path, capsys):
+    import numpy as np
+
+    from pluss import cli
+
+    path = tmp_path / "t.bin"
+    rng = np.random.default_rng(0)
+    addrs = (rng.integers(0, 256, 5000) * 64).astype("<u8")
+    addrs.tofile(path)
+    out = tmp_path / "m.csv"
+    cli.main(["trace", "--file", str(path), "--out", str(out), "--cpu"])
+    got = capsys.readouterr().out
+    assert "TPU TRACE:" in got and "Start to dump reuse time" in got
+    assert f"5000 refs over" in got
+    assert out.read_text().startswith("miss ratio")
