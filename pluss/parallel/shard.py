@@ -130,9 +130,9 @@ def _shard_body(tids, pl: StreamPlan, share_cap: int, D: int):
 
 @functools.lru_cache(maxsize=None)
 def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
-              mesh: Mesh):
+              mesh: Mesh, assignment=None, start_point=None):
     D = mesh.devices.size
-    pl = plan(spec, cfg, n_windows=D)
+    pl = plan(spec, cfg, assignment, start_point, n_windows=D)
     f = jax.shard_map(
         lambda t: _shard_body(t, pl, share_cap, D),
         mesh=mesh,
@@ -144,10 +144,19 @@ def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
 
 def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
               share_cap: int = SHARE_CAP,
-              mesh: Mesh | None = None) -> SamplerResult:
-    """Run the sampler with stream windows sharded over a device mesh."""
+              mesh: Mesh | None = None,
+              assignment=None, start_point=None) -> SamplerResult:
+    """Run the sampler with stream windows sharded over a device mesh.
+
+    ``assignment``/``start_point``: dynamic chunk->thread maps and the
+    setStartPoint resume rule, as in :func:`pluss.engine.run`.
+    """
     mesh = mesh or default_mesh()
-    pl, f = _compiled(spec, cfg, share_cap, mesh)
+    if assignment is not None:
+        assignment = tuple(
+            tuple(a) if a is not None else None for a in assignment
+        )
+    pl, f = _compiled(spec, cfg, share_cap, mesh, assignment, start_point)
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
     hist, sv, sc, snu, head_share = f(tids)
     # [D, T, N, ...] -> [T, D, N, ...]: merge_share_windows flattens every

@@ -122,6 +122,14 @@ def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
         return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)
     lines = addrs.astype(np.int64) if precompacted else lines_of(addrs, cls)
 
+    # dense-range shortcut: when the touched lines span a small range the
+    # offset IS the id — no vocabulary pass at all (last_pos is sized by the
+    # range; untouched slots just stay -1)
+    lo_line, hi_line = int(lines.min()), int(lines.max())
+    if hi_line - lo_line < 1 << 24:
+        ids = (lines - lo_line).astype(np.int32)
+        return _replay_ids(ids, int(hi_line - lo_line + 1), n, window)
+
     # host compaction: incremental vocabulary over chunks, fully vectorized
     # (sorted key array + parallel id array; ids are assignment-ordered and
     # stay stable as the vocabulary grows)
@@ -146,8 +154,12 @@ def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
         )
         next_id += len(new_keys)
         ids[lo:lo + window] = ids_sorted[np.searchsorted(keys_sorted, chunk)]
-    n_lines = next_id
+    return _replay_ids(ids, next_id, n, window)
 
+
+def _replay_ids(ids: np.ndarray, n_lines: int, n: int,
+                window: int) -> ReplayResult:
+    """Stream dense line ids through the device scan in fixed-shape batches."""
     batch = WINDOWS_PER_BATCH * window
     n_batches = -(-n // batch)
     pos_dtype = "int32" if n_batches * batch < 2**31 - 2 else "int64"

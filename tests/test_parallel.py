@@ -58,3 +58,21 @@ def test_shard_more_devices_than_rounds():
 
 def test_mesh_is_virtual_8_cpu():
     assert len(jax.devices()) == 8
+
+
+def test_shard_dynamic_assignment_and_resume():
+    # dynamic chunk->thread map + setStartPoint resume through the sharded
+    # backend must agree with the single-device engine
+    from pluss.engine import run
+    from pluss.parallel.shard import default_mesh, shard_run
+    from pluss.sched import ChunkSchedule
+
+    cfg = SamplerConfig(cls=8)
+    spec = gemm(16)
+    sched = ChunkSchedule(cfg.chunk_size, 16, 0, 1, cfg.thread_num)
+    asg = tuple((c + 1) % cfg.thread_num for c in range(sched.n_chunks))
+    for kw in ({"assignment": (asg,)}, {"start_point": 8}):
+        a = run(spec, cfg, **kw)
+        b = shard_run(spec, cfg, mesh=default_mesh(4), **kw)
+        assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
+        assert a.share_list() == b.share_list()

@@ -73,3 +73,22 @@ def test_load_trace_roundtrip(tmp_path):
     pt = tmp_path / "t.txt"
     pt.write_text("8\n0x10\n8\n4096\n")
     assert (trace.load_trace(str(pt), "text") == addrs.astype(np.int64)).all()
+
+
+def test_replay_sparse_addresses_use_compaction():
+    # line range >> 2^24 forces the vocabulary pass; histogram must still
+    # match the oracle and n_lines the true unique count
+    rng = np.random.default_rng(3)
+    base = rng.integers(0, 1 << 44, 50, dtype=np.int64) * 64
+    addrs = base[rng.integers(0, 50, 4000)]
+    res = trace.replay(addrs, window=1 << 10)
+    assert res.n_lines == len(np.unique(base // 64))
+    assert res.histogram() == oracle_replay(addrs)
+
+
+def test_replay_dense_range_shortcut_offsets():
+    # lines in a small range far from zero: ids are range offsets
+    addrs = (np.array([5, 6, 5, 7, 6], np.int64) + (1 << 30)) * 64
+    res = trace.replay(addrs)
+    assert res.n_lines == 3
+    assert res.histogram() == oracle_replay(addrs)
