@@ -81,6 +81,21 @@ long long pluss_get_mrc(void* hp, double* out, long long cap) {
   return n;
 }
 
+// Dynamic trace replay: the handle's ri/mrc getters serve the result; the
+// sampler-specific getters see empty per-thread histograms.
+void* pluss_replay(const long long* addrs, long long n, int cls,
+                   long long cache_kb) {
+  try {
+    auto h = std::make_unique<Handle>();
+    h->cfg = {1, 1, 8, cls, cache_kb};
+    h->ri = pluss::replay_trace(addrs, n, cls);
+    h->res.total_count = n;
+    return h.release();
+  } catch (...) {
+    return nullptr;
+  }
+}
+
 void pluss_destroy(void* hp) { delete static_cast<Handle*>(hp); }
 
 }  // extern "C"

@@ -166,8 +166,35 @@ int main(int argc, char** argv) {
     std::vector<double> mrc = pluss::aet_mrc(pluss::cri_distribute(res, cfg), cfg);
     pluss::write_mrc(mrc, path);
     std::printf("wrote MRC over %zu cache sizes to %s\n", mrc.size(), path);
+  } else if (mode == "trace") {
+    // native twin of `python -m pluss.cli trace`: replay a packed-u64
+    // address file (the reference's disabled pluss_access path, live)
+    const char* path = argc > 2 ? argv[2] : nullptr;
+    if (!path) {
+      std::fprintf(stderr, "usage: %s trace <u64-file> [mrc_path]\n", argv[0]);
+      return 2;
+    }
+    std::FILE* f = std::fopen(path, "rb");
+    if (!f) {
+      std::fprintf(stderr, "cannot open %s\n", path);
+      return 1;
+    }
+    std::vector<long long> addrs;
+    long long a;
+    while (std::fread(&a, sizeof(a), 1, f) == 1) addrs.push_back(a);
+    std::fclose(f);
+    Timer t;
+    t.start();
+    Histogram h = pluss::replay_trace(addrs.data(),
+                                      (long long)addrs.size(), cfg.cls);
+    std::printf("NATIVE TRACE: %0.6f\n", t.stop());
+    print_hist("Start to dump reuse time", h);
+    std::printf("max iteration traversed\n%lld\n\n", (long long)addrs.size());
+    if (argc > 3) pluss::write_mrc(pluss::aet_mrc(h, cfg), argv[3]);
   } else {
-    std::fprintf(stderr, "usage: %s {acc|speed|mrc} [n] [mrc_path]\n", argv[0]);
+    std::fprintf(stderr,
+                 "usage: %s {acc|speed|mrc|trace} [n|file] [mrc_path]\n",
+                 argv[0]);
     return 2;
   }
   return 0;

@@ -254,6 +254,26 @@ Histogram cri_distribute(const SampleResult& r, const Config& cfg) {
   return ri;
 }
 
+// ---- dynamic trace replay (pluss.cpp:126-160 semantics) --------------------
+Histogram replay_trace(const long long* addrs, long long n, int cls) {
+  int shift = 0;
+  while ((1LL << shift) < cls) ++shift;
+  std::unordered_map<long long, long long> lat;
+  Histogram h;
+  for (long long clock = 0; clock < n; ++clock) {
+    long long line = addrs[clock] >> shift;
+    auto it = lat.find(line);
+    if (it != lat.end()) {
+      histogram_update(h, clock - it->second, 1.0);
+      it->second = clock;
+    } else {
+      histogram_update(h, -1, 1.0);
+      lat.emplace(line, clock);
+    }
+  }
+  return h;
+}
+
 // ---- AET -> MRC ------------------------------------------------------------
 
 std::vector<double> aet_mrc(const Histogram& ri, const Config& cfg) {

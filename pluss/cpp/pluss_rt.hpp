@@ -82,6 +82,13 @@ void cri_racetrack(const std::vector<Histogram>& share, Histogram& ri,
                    int thread_cnt, int share_ratio);              // :1040-1131
 Histogram cri_distribute(const SampleResult& r, const Config& cfg); // :1204-1208
 
+// ---- dynamic trace replay --------------------------------------------------
+// The reference's disabled trace-driven API (pluss_access: line masking,
+// global clock, last-access map — c_lib/test/runtime/pluss.cpp:126-160,
+// CACHE_MASK at :13), live here.  Single-clock: feeds aet_mrc directly,
+// no CRI dilation (the trace path bypasses the CRI model).
+Histogram replay_trace(const long long* addrs, long long n, int cls);
+
 // ---- AET -> MRC (pluss_utils.h:758-804, 851-913) ---------------------------
 constexpr double kMrcDedupEps = 1e-5;  // pluss_utils.h:863,899
 std::vector<double> aet_mrc(const Histogram& ri, const Config& cfg);

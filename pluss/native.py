@@ -86,6 +86,9 @@ def _load():
     lib.pluss_get_ri.argtypes = [ctypes.c_void_p, i64p, f64p, ctypes.c_longlong]
     lib.pluss_get_mrc.restype = ctypes.c_longlong
     lib.pluss_get_mrc.argtypes = [ctypes.c_void_p, f64p, ctypes.c_longlong]
+    lib.pluss_replay.restype = ctypes.c_void_p
+    lib.pluss_replay.argtypes = [i64p, ctypes.c_longlong, ctypes.c_int,
+                                 ctypes.c_longlong]
     lib.pluss_destroy.restype = None
     lib.pluss_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
@@ -189,3 +192,18 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT) -> NativeResult:
     if not h:
         raise ValueError("native runtime rejected the spec")
     return NativeResult(h, lib, cfg.thread_num)
+
+
+def replay(addrs: np.ndarray, cls: int = 64,
+           cache_kb: int = DEFAULT.cache_kb) -> NativeResult:
+    """Native dynamic trace replay (``pluss::replay_trace``) — the C++ twin of
+    :func:`pluss.trace.replay`; results via ``rihist()``/``mrc()``."""
+    lib = _load()
+    a = np.ascontiguousarray(np.asarray(addrs, np.int64))
+    h = lib.pluss_replay(
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), len(a),
+        cls, cache_kb,
+    )
+    if not h:
+        raise RuntimeError("native replay failed")
+    return NativeResult(h, lib, thread_num=1)

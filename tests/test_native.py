@@ -108,3 +108,16 @@ def test_standalone_binary_gemm128_golden():
     for line in ("-1,12288,", "1,2.12787e+06,", "512,1.83501e+06,"):
         assert line in out, line
     assert "62194,253952,1" in out  # the single share value
+
+
+def test_native_trace_replay_matches_python():
+    rng = np.random.default_rng(11)
+    addrs = rng.integers(0, 1 << 16, 20000).astype(np.int64) * 8
+    from pluss import trace
+
+    nat = native.replay(addrs)
+    assert nat.rihist() == trace.replay(addrs).histogram()
+    assert nat.max_iteration_count == len(addrs)
+    # the trace path feeds AET directly; curves must agree too
+    ours = mrc.aet_mrc(trace.replay(addrs).histogram())
+    assert mrc.l2_error(ours, nat.mrc()) < 1e-12
