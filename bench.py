@@ -36,22 +36,14 @@ def log(msg: str) -> None:
 
 
 def probe_accelerator() -> str | None:
-    """Platform name of a usable non-CPU backend, or None. Subprocess + timeout
-    so a wedged TPU tunnel cannot hang the bench."""
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-        )
-    except subprocess.TimeoutExpired:
-        log("bench: accelerator probe timed out (wedged tunnel?)")
-        return None
-    if out.returncode != 0:
-        log(f"bench: accelerator probe failed: {out.stderr.strip()[-200:]}")
-        return None
-    plat = out.stdout.strip()
-    return plat if plat and plat != "cpu" else None
+    """Killable accelerator probe (see pluss.utils.platform.probe_accelerator:
+    a wedged TPU tunnel must not hang the bench)."""
+    from pluss.utils.platform import probe_accelerator as probe
+
+    plat = probe(PROBE_TIMEOUT_S)
+    if plat is None:
+        log("bench: no usable accelerator (wedged tunnel or CPU-only box)")
+    return plat
 
 
 def native_baseline_s(n: int) -> float | None:

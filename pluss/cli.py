@@ -106,6 +106,18 @@ def main(argv: list[str] | None = None) -> int:
         from pluss.utils.platform import force_cpu
 
         force_cpu(8)
+    else:
+        # a wedged TPU tunnel hangs any jax op forever; probe killably and
+        # degrade to the CPU backend instead of hanging the driver.  Skip
+        # when the process is already pinned to CPU (tests, prior force_cpu).
+        import jax
+
+        from pluss.utils.platform import force_cpu, probe_accelerator
+
+        if jax.config.jax_platforms != "cpu" and probe_accelerator() is None:
+            print("pluss: no usable accelerator, falling back to CPU",
+                  file=sys.stderr)
+            force_cpu(8)
 
     spec = REGISTRY[args.model](args.n)
     cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk)

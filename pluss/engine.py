@@ -330,8 +330,7 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     """
     T = cfg.thread_num
     target = window_accesses or WINDOW_TARGET
-    nests: list[NestPlan] = []
-    iters = np.zeros((len(spec.nests), T), np.int64)
+    geom = []  # (sched, refs, body, asg, owned, W, NW) per nest
     for ni, nest in enumerate(spec.nests):
         sched = ChunkSchedule(cfg.chunk_size, nest.trip, nest.start, nest.step, T)
         refs = tuple(flatten_nest(nest))
@@ -347,7 +346,26 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             W = max(1, min(R, -(-target // (cfg.chunk_size * body))))
             NW = -(-R // W)
         pad = np.full((T, NW * W - R), -1, np.int32)
-        owned = np.concatenate([owned, pad], axis=1)
+        geom.append((sched, refs, body, asg,
+                     np.concatenate([owned, pad], axis=1), W, NW))
+
+    # padded per-thread clock bound picks the position dtype — checked BEFORE
+    # the (window-sized) template builds so oversize plans fail fast.  The
+    # full int32 range is usable because no event math doubles a position
+    # (the share test is division-sided, ops/reuse.share_mask).
+    max_clock = int(
+        sum(NW * W * cfg.chunk_size * body for _, _, body, _, _, W, NW in geom)
+    )
+    pos_dtype = np.dtype(np.int32) if max_clock < 2**31 - 2 else np.dtype(np.int64)
+    if pos_dtype == np.int64 and not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            f"stream of {max_clock} accesses/thread needs int64 positions; "
+            "enable jax_enable_x64"
+        )
+
+    nests: list[NestPlan] = []
+    iters = np.zeros((len(spec.nests), T), np.int64)
+    for ni, (sched, refs, body, asg, owned, W, NW) in enumerate(geom):
         tpl = clean = None
         # custom chunk->thread maps break the linear cid progression the
         # shift-invariance argument rests on; the sort path handles them
@@ -367,18 +385,6 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     nest_base = np.zeros_like(iters)
     nest_base[1:] = np.cumsum(iters[:-1] * body_sizes[:-1, None], axis=0)
     total = int((iters * body_sizes[:, None]).sum())
-    # padded per-thread clock bound picks the position dtype; the full int32
-    # range is usable because no event math doubles a position (the share
-    # test is division-sided, ops/reuse.py)
-    max_clock = int(
-        sum(n.n_windows * n.window_rounds * cfg.chunk_size * n.body for n in nests)
-    )
-    pos_dtype = np.dtype(np.int32) if max_clock < 2**31 - 2 else np.dtype(np.int64)
-    if pos_dtype == np.int64 and not jax.config.jax_enable_x64:
-        raise RuntimeError(
-            f"stream of {max_clock} accesses/thread needs int64 positions; "
-            "enable jax_enable_x64"
-        )
     return StreamPlan(
         spec=spec,
         cfg=cfg,

@@ -51,3 +51,27 @@ def has_accelerator() -> bool:
         return jax.devices()[0].platform != "cpu"
     except Exception:
         return False
+
+
+def probe_accelerator(timeout_s: float = 120.0) -> str | None:
+    """Platform name of a usable non-CPU backend, or None.
+
+    Probes in a SUBPROCESS with a hard timeout: the tunneled-TPU backend this
+    image registers can hang indefinitely when the tunnel is wedged, so the
+    probing must be killable.  Callers fall back to :func:`force_cpu` on None.
+    """
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        return None
+    plat = out.stdout.strip()
+    return plat if plat and plat != "cpu" else None

@@ -166,3 +166,14 @@ def test_fast_path_matches_sort_path():
     small_windows = run(spec, window_accesses=4096)  # several windows
     assert base.noshare_list() == small_windows.noshare_list()
     assert base.share_list() == small_windows.share_list()
+
+
+def test_oversize_stream_needs_x64():
+    # per-thread clock past 2^31 requires int64 positions; without
+    # jax_enable_x64 plan() must fail fast (before any template build)
+    import pytest
+
+    from pluss.engine import plan
+
+    with pytest.raises(RuntimeError, match="int64 positions"):
+        plan(gemm(4096))
