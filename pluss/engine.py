@@ -55,6 +55,10 @@ from pluss.spec import FlatRef, LoopNestSpec, flatten_nest, nest_iteration_size
 #: than this compile to a single window with no scan overhead.
 WINDOW_TARGET = 1 << 23
 
+#: largest window the plan-time template analysis will host-lexsort; bigger
+#: windows (tiny meshes in n_windows mode) fall back to the device sort path
+MAX_TEMPLATE_WINDOW = 1 << 27
+
 
 @dataclasses.dataclass(frozen=True)
 class WindowTemplate:
@@ -368,8 +372,11 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     for ni, (sched, refs, body, asg, owned, W, NW) in enumerate(geom):
         tpl = clean = None
         # custom chunk->thread maps break the linear cid progression the
-        # shift-invariance argument rests on; the sort path handles them
-        if asg is None and _static_perm_eligible(refs, sched, cfg):
+        # shift-invariance argument rests on; the sort path handles them.
+        # Oversize windows would make the host-side template analysis itself
+        # the bottleneck — skip it and let the device sort.
+        if (asg is None and _static_perm_eligible(refs, sched, cfg)
+                and W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW):
             clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
             tpl = _build_template(
                 refs, W, cfg, sched, owned, clean, spec.line_bases(cfg),

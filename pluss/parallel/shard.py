@@ -62,10 +62,46 @@ def default_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devs[:n]), ("d",))
 
 
-def _device_segments(tid, pl: StreamPlan, share_cap: int, d):
+def _tpl_dense(tpl, tid, d, n_lines, pos_dtype, nb):
+    """Template path: dense (head_pos, head_span, tail_pos) for window ``d``
+    of thread ``tid`` — no stream materialization.
+
+    The shift arithmetic mirrors the engine's ``ultra_step`` (units per chunk
+    offset, positions per window); the dense arrays are built with one
+    ``dynamic_update_slice`` per contiguous line run (scatter fallback for
+    fragmented line sets).
+    """
+    pdt = jnp.dtype(pos_dtype)
+    units = (d - tpl.w0) * tpl.unit_w + (tid - tpl.t0)
+    dpos = jnp.asarray(tpl.pos_shift, pdt) * (d - tpl.w0).astype(pdt) + nb
+
+    def dense(runs, lines, dlines, vals, fill):
+        out = jnp.full((n_lines,), fill, vals.dtype)
+        if runs is None:
+            idx = jnp.asarray(lines) + jnp.asarray(dlines) * units
+            return out.at[idx].set(vals, unique_indices=True)
+        for ls, off, ln, dl in runs:
+            out = jax.lax.dynamic_update_slice(
+                out, vals[int(off):int(off) + int(ln)],
+                (int(ls) + int(dl) * units,),
+            )
+        return out
+
+    hpos = jnp.asarray(tpl.head_pos.astype(pos_dtype)) + dpos
+    tpos = jnp.asarray(tpl.tail_pos.astype(pos_dtype)) + dpos
+    head_pos = dense(tpl.head_runs, tpl.head_line, tpl.head_dline, hpos, -1)
+    head_span = dense(tpl.head_runs, tpl.head_line, tpl.head_dline,
+                      jnp.asarray(tpl.head_span), 0)
+    tail_pos = dense(tpl.tail_runs, tpl.tail_line, tpl.tail_dline, tpos, -1)
+    return head_pos, head_span, tail_pos
+
+
+def _device_segments(tid, pl: StreamPlan, share_cap: int, d, ultra_nests):
     """One device's segments (one window per nest) for one simulated thread.
 
     Returns per-nest stacked local results plus dense boundary arrays.
+    ``ultra_nests[ni]`` selects the static-template path (all windows clean,
+    decided at trace time) vs the sort path.
     """
     cfg = pl.cfg
     bases = pl.spec.line_bases(cfg)
@@ -74,17 +110,28 @@ def _device_segments(tid, pl: StreamPlan, share_cap: int, d):
     nest_base = jnp.asarray(pl.nest_base.astype(pl.pos_dtype))
     hists, svs, scs, snus, hps, hss, tps = [], [], [], [], [], [], []
     for ni, np_ in enumerate(pl.nests):
-        owned_row = jnp.asarray(np_.owned)[tid]
-        r0 = d * np_.window_rounds
-        key_s, pos_s, span_s, valid_i = window_stream(
-            np_, cfg, owned_row, r0, nest_base[ni, tid], bases,
-            pl.spec.array_index, pdt,
-        )
-        ev, _ = window_events(key_s, pos_s, span_s, valid_i, None)
-        hists.append(event_histogram(ev))
-        sv, sc, snu = share_unique(ev, share_cap)
-        svs.append(sv); scs.append(sc); snus.append(snu)
-        hp, hs, tp = boundary_arrays(key_s, pos_s, span_s, ev, n_lines)
+        if ultra_nests[ni]:
+            tpl = np_.tpl
+            hp, hs, tp = _tpl_dense(tpl, tid, d, n_lines, pl.pos_dtype,
+                                    nest_base[ni, tid])
+            hists.append(jnp.asarray(tpl.local_hist.astype(pl.pos_dtype)))
+            # static in-window share values are added HOST-side in shard_run
+            # (uncapped, like engine.run) — the device emits none
+            svs.append(jnp.zeros((share_cap,), pdt))
+            scs.append(jnp.zeros((share_cap,), jnp.int32))
+            snus.append(jnp.int32(0))
+        else:
+            r0 = d * np_.window_rounds
+            owned_row = jnp.asarray(np_.owned)[tid]
+            key_s, pos_s, span_s, valid_i = window_stream(
+                np_, cfg, owned_row, r0, nest_base[ni, tid], bases,
+                pl.spec.array_index, pdt,
+            )
+            ev, _ = window_events(key_s, pos_s, span_s, valid_i, None)
+            hists.append(event_histogram(ev))
+            sv, sc, snu = share_unique(ev, share_cap)
+            svs.append(sv); scs.append(sc); snus.append(snu)
+            hp, hs, tp = boundary_arrays(key_s, pos_s, span_s, ev, n_lines)
         hps.append(hp); hss.append(hs); tps.append(tp)
     stack = lambda xs: jnp.stack(xs)
     return (stack(hists), stack(svs), stack(scs), stack(snus),
@@ -94,8 +141,14 @@ def _device_segments(tid, pl: StreamPlan, share_cap: int, d):
 def _shard_body(tids, pl: StreamPlan, share_cap: int, D: int):
     d = jax.lax.axis_index("d")
     N = len(pl.nests)
+    # template path per nest iff every window of every thread is clean — a
+    # trace-time (static) condition, so the SPMD program stays uniform
+    ultra = tuple(
+        n.tpl is not None and n.clean is not None and bool(n.clean.all())
+        for n in pl.nests
+    )
     (hist, sv, sc, snu, head_pos, head_span, tail_pos) = jax.vmap(
-        lambda t: _device_segments(t, pl, share_cap, d)
+        lambda t: _device_segments(t, pl, share_cap, d, ultra)
     )(tids)
     # tail exchange: [D, T, N, L] — the only cross-device state
     tails_all = jax.lax.all_gather(tail_pos, "d")
@@ -156,6 +209,13 @@ def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         assignment = tuple(
             tuple(a) if a is not None else None for a in assignment
         )
+    if mesh.devices.size == 1:
+        # a 1-device "mesh" would make the whole stream one window; the
+        # windowed engine is the same computation with bounded memory
+        from pluss import engine
+
+        return engine.run(spec, cfg, share_cap, assignment=assignment,
+                          start_point=start_point)
     pl, f = _compiled(spec, cfg, share_cap, mesh, assignment, start_point)
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
     hist, sv, sc, snu, head_share = f(tids)
@@ -172,6 +232,17 @@ def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         for t in range(T):
             for v in hv[dev, t][hv[dev, t] >= 0].tolist():
                 share_raw[t][v] = share_raw[t].get(v, 0) + 1
+    # static in-window share of template nests: one copy per (thread, window)
+    D = mesh.devices.size
+    for np_ in pl.nests:
+        if np_.tpl is None or np_.clean is None or not np_.clean.all():
+            continue
+        pairs = list(zip(np_.tpl.share_vals.tolist(),
+                         (np_.tpl.share_cnts * D).tolist()))
+        for t in range(T):
+            d = share_raw[t]
+            for v, c in pairs:
+                d[v] = d.get(v, 0) + c
     return SamplerResult(
         noshare_dense=np.asarray(hist, np.int64),
         share_raw=share_raw,

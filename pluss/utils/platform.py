@@ -70,8 +70,12 @@ def probe_accelerator(timeout_s: float = 120.0) -> str | None:
             capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
+        print(f"pluss: accelerator probe timed out after {timeout_s:.0f}s "
+              "(wedged tunnel?)", file=sys.stderr)
         return None
     if out.returncode != 0:
+        print("pluss: accelerator probe failed: "
+              f"{out.stderr.strip()[-200:]}", file=sys.stderr)
         return None
     plat = out.stdout.strip()
     return plat if plat and plat != "cpu" else None

@@ -169,11 +169,18 @@ def test_fast_path_matches_sort_path():
 
 
 def test_oversize_stream_needs_x64():
-    # per-thread clock past 2^31 requires int64 positions; without
-    # jax_enable_x64 plan() must fail fast (before any template build)
+    # per-thread clock past 2^31 requires int64 positions; with
+    # jax_enable_x64 OFF (pinned explicitly — image defaults vary) plan()
+    # must fail fast, before any template build
+    import jax
     import pytest
 
     from pluss.engine import plan
 
-    with pytest.raises(RuntimeError, match="int64 positions"):
-        plan(gemm(4096))
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        with pytest.raises(RuntimeError, match="int64 positions"):
+            plan(gemm(4096))
+    finally:
+        jax.config.update("jax_enable_x64", prev)

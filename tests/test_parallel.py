@@ -76,3 +76,20 @@ def test_shard_dynamic_assignment_and_resume():
         b = shard_run(spec, cfg, mesh=default_mesh(4), **kw)
         assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
         assert a.share_list() == b.share_list()
+
+
+def test_shard_ultra_template_path_matches_engine():
+    # gemm(64): 16 chunks / 4 threads = 4 rounds -> a 4-device mesh gives one
+    # FULL clean window per device, activating the static-template shard path
+    from pluss.engine import plan, run
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    cfg = SamplerConfig()
+    pl = plan(gemm(64), cfg, n_windows=4)
+    n = pl.nests[0]
+    assert n.tpl is not None and n.clean.all(), "precondition: ultra active"
+    a = run(gemm(64), cfg)
+    b = shard_run(gemm(64), cfg, mesh=default_mesh(4))
+    assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
+    assert a.share_list() == b.share_list()
+    assert a.max_iteration_count == b.max_iteration_count
