@@ -63,14 +63,24 @@ def sort_stream(line, pos, span, valid, pos_sorted: bool = False):
     position order (e.g. a replayed trace window) — then a *stable* sort on
     the line key alone preserves position order at half the comparator cost.
 
+    Payload is kept minimal (sort cost scales with operand count): validity
+    is re-derived from the sentinel key after the sort, and a ``None`` span
+    (trace streams have no share classification) is never shipped through
+    the sort at all.
+
     Returns (key_s, pos_s, span_s, valid_s[int32]).
     """
     key = jnp.where(valid, line, LINE_SENTINEL)
-    return jax.lax.sort(
-        (key, pos, span, valid.astype(jnp.int32)),
-        num_keys=1 if pos_sorted else 2,
-        is_stable=pos_sorted,
-    )
+    nk = 1 if pos_sorted else 2
+    if span is None:
+        key_s, pos_s = jax.lax.sort((key, pos), num_keys=nk,
+                                    is_stable=pos_sorted)
+        span_s = jnp.zeros_like(key_s)
+    else:
+        key_s, pos_s, span_s = jax.lax.sort((key, pos, span), num_keys=nk,
+                                            is_stable=pos_sorted)
+    valid_s = (key_s != LINE_SENTINEL).astype(jnp.int32)
+    return key_s, pos_s, span_s, valid_s
 
 
 def window_events(key_s, pos_s, span_s, valid_i, last_pos):

@@ -90,10 +90,10 @@ def _replay_fn(window: int, n_lines: int, pos_dtype_name: str):
         def step(carry, xs):
             last_pos, hist = carry
             line_w, pos_w, valid_w = xs
-            span = jnp.zeros_like(line_w)
-            # trace windows arrive in stream order: stable single-key sort
+            # trace windows arrive in stream order: stable single-key sort,
+            # no span payload (the trace path has no share classification)
             ev, last_pos = window_events(
-                *sort_stream(line_w, pos_w, span, valid_w, pos_sorted=True),
+                *sort_stream(line_w, pos_w, None, valid_w, pos_sorted=True),
                 last_pos,
             )
             return (last_pos, hist + event_histogram(ev)), None

@@ -8,13 +8,16 @@
 set -e
 METHOD=${1:-acc}
 N=${2:-128}
+MODEL=${MODEL:-gemm}
 CLI_FLAGS=${PLUSS_CLI_FLAGS---cpu}
 
 if [ ! -f pluss/cpp/build/pluss_cpp ] && [ -d pluss/cpp ]; then
   (cd pluss/cpp && make -s)
 fi
-if [ -f pluss/cpp/build/pluss_cpp ]; then
+# the native binary hardwires the GEMM spec; other models compare via the
+# ctypes binding (tests/test_native.py)
+if [ -f pluss/cpp/build/pluss_cpp ] && [ "$MODEL" = gemm ]; then
   ./pluss/cpp/build/pluss_cpp "$METHOD" "$N" >> output.txt
 fi
 
-python -m pluss.cli "$METHOD" --n "$N" $CLI_FLAGS >> output.txt
+python -m pluss.cli "$METHOD" --model "$MODEL" --n "$N" $CLI_FLAGS >> output.txt

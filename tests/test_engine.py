@@ -184,3 +184,36 @@ def test_oversize_stream_needs_x64():
             plan(gemm(4096))
     finally:
         jax.config.update("jax_enable_x64", prev)
+
+
+def test_oversize_window_skips_template():
+    # a 1-window plan of GEMM-1024 (1.07e9 accesses/window) must not attempt
+    # the host template analysis; the sort path takes over
+    from pluss.engine import MAX_TEMPLATE_WINDOW, plan
+
+    pl = plan(gemm(1024), n_windows=1)
+    n = pl.nests[0]
+    assert n.window_rounds * 4 * n.body > MAX_TEMPLATE_WINDOW
+    assert n.tpl is None
+
+
+def test_nonzero_start_and_stride_matches_oracle():
+    # loops with start!=0 / step!=1 (the reference dispatcher's general
+    # constructor, pluss_utils.h:325-334) through the full engine
+    from pluss.spec import Loop, LoopNestSpec, Ref
+
+    spec = LoopNestSpec(
+        name="strided",
+        arrays=(("A", 600), ("B", 600)),
+        nests=(
+            Loop(trip=10, start=2, step=3, body=(
+                Ref("A0", "A", addr_terms=((0, 8),)),
+                Loop(trip=6, start=1, step=2, body=(
+                    Ref("B0", "B", addr_terms=((0, 4), (1, 7)), share_span=29),
+                    Ref("A1", "A", addr_terms=((1, 3),)),
+                )),
+            )),
+        ),
+    )
+    assert_matches_oracle(spec, SamplerConfig(cls=8))
+    assert_matches_oracle(spec, SamplerConfig(cls=8), window_accesses=32)
