@@ -32,11 +32,21 @@ def force_cpu(n_virtual_devices: int | None = None) -> None:
                 r"--xla_force_host_platform_device_count=\d+", want, flags
             )
     import jax
+    from jax._src import xla_bridge
 
+    if (n_virtual_devices is not None
+            and xla_bridge.backends_are_initialized()
+            and len(jax.devices()) < n_virtual_devices):
+        # XLA parses --xla_force_host_platform_device_count ONCE per process;
+        # clearing backends does not re-read it, so growth cannot work —
+        # fail loudly instead of silently serving a smaller mesh
+        raise RuntimeError(
+            f"{len(jax.devices())} virtual devices already initialized; "
+            f"cannot grow to {n_virtual_devices} in this process (XLA reads "
+            "the device-count flag once). Request the largest count first."
+        )
     if jax.config.jax_platforms != "cpu":
         jax.config.update("jax_platforms", "cpu")
-        from jax._src import xla_bridge
-
         if xla_bridge.backends_are_initialized():
             from jax.extend.backend import clear_backends
 

@@ -18,3 +18,23 @@ def test_entry_compiles_and_runs():
 def test_dryrun_multichip():
     # dryrun_multichip pins an 8-device virtual CPU mesh itself
     ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_small_meshes():
+    # smaller meshes than the initialized device count must also hold (XLA
+    # reads the virtual-device-count flag once per process, so counts can
+    # only descend within a process — growth raises, tested below)
+    ge.dryrun_multichip(4)
+    ge.dryrun_multichip(2)
+
+
+def test_virtual_device_growth_raises():
+    import pytest
+
+    from pluss.utils.platform import force_cpu
+
+    import jax
+
+    n = len(jax.devices())
+    with pytest.raises(RuntimeError, match="cannot grow"):
+        force_cpu(n + 1)
