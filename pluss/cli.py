@@ -63,17 +63,18 @@ def _timed(step, profile_dir: str | None = None):
     """Time one (sampler + distribute) step — the reference's timed region
     (…omp.cpp:337-339).  ``profile_dir`` wraps the step in a jax profiler
     trace (the observability hook the reference's DEBUG prints stand in for)."""
+    import contextlib
+
+    ctx = contextlib.nullcontext()
     if profile_dir:
         import jax
 
-        with jax.profiler.trace(profile_dir):
-            t0 = time.perf_counter()
-            res, ri = step()
-            dt = time.perf_counter() - t0
-        return dt, res, ri
-    t0 = time.perf_counter()
-    res, ri = step()
-    return time.perf_counter() - t0, res, ri
+        ctx = jax.profiler.trace(profile_dir)
+    with ctx:
+        t0 = time.perf_counter()
+        res, ri = step()
+        dt = time.perf_counter() - t0
+    return dt, res, ri
 
 
 def banner_of(backend: str) -> str:

@@ -609,7 +609,7 @@ def _unpack(flat: np.ndarray, pl: StreamPlan, share_cap: int):
     return hist, share_ys
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=64)
 def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
              assignment=None, start_point=None, window_accesses=None,
              backend: str = "vmap"):
@@ -673,6 +673,21 @@ class SamplerResult:
         return [self.share_dict(t) for t in range(self.thread_num)]
 
 
+def add_static_share(share_raw: list[dict],
+                     nest_windows: list[tuple[NestPlan, int]]) -> None:
+    """Add each template nest's static in-window share events to every
+    thread's raw dict, once per ultra window (they are identical for every
+    clean window of every thread — shift invariance)."""
+    for np_, n_windows in nest_windows:
+        if not n_windows or np_.tpl is None or not np_.tpl.share_vals.size:
+            continue
+        pairs = list(zip(np_.tpl.share_vals.tolist(),
+                         (np_.tpl.share_cnts * n_windows).tolist()))
+        for d in share_raw:
+            for v, c in pairs:
+                d[v] = d.get(v, 0) + c
+
+
 def merge_share_windows(svals, scnts, snu, share_cap: int,
                         thread_num: int) -> list[dict]:
     """Host-side merge of per-(thread, window) share uniques into raw dicts."""
@@ -719,18 +734,9 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     )
     # static in-window share events of ultra windows are host-side constants:
     # identical values and counts for every clean window of every thread
-    for np_ in pl.nests:
-        if np_.tpl is None or not np_.tpl.share_vals.size:
-            continue
-        n_ultra = int(np_.clean.all(axis=0).sum())
-        if not n_ultra:
-            continue
-        pairs = list(zip(np_.tpl.share_vals.tolist(),
-                         (np_.tpl.share_cnts * n_ultra).tolist()))
-        for t in range(cfg.thread_num):
-            d = share_raw[t]
-            for v, c in pairs:
-                d[v] = d.get(v, 0) + c
+    add_static_share(share_raw,
+                     [(n, int(n.clean.all(axis=0).sum()) if n.tpl is not None
+                       else 0) for n in pl.nests])
     return SamplerResult(
         noshare_dense=np.asarray(hist, np.int64),
         share_raw=share_raw,

@@ -181,7 +181,7 @@ def _shard_body(tids, pl: StreamPlan, share_cap: int, D: int):
     return total, sv[None], sc[None], snu[None], head_share[None]
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
               mesh: Mesh, assignment=None, start_point=None):
     D = mesh.devices.size
@@ -234,15 +234,13 @@ def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                 share_raw[t][v] = share_raw[t].get(v, 0) + 1
     # static in-window share of template nests: one copy per (thread, window)
     D = mesh.devices.size
-    for np_ in pl.nests:
-        if np_.tpl is None or np_.clean is None or not np_.clean.all():
-            continue
-        pairs = list(zip(np_.tpl.share_vals.tolist(),
-                         (np_.tpl.share_cnts * D).tolist()))
-        for t in range(T):
-            d = share_raw[t]
-            for v, c in pairs:
-                d[v] = d.get(v, 0) + c
+    from pluss.engine import add_static_share
+
+    add_static_share(share_raw, [
+        (n, D if n.tpl is not None and n.clean is not None
+         and bool(n.clean.all()) else 0)
+        for n in pl.nests
+    ])
     return SamplerResult(
         noshare_dense=np.asarray(hist, np.int64),
         share_raw=share_raw,

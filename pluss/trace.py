@@ -75,7 +75,7 @@ class ReplayResult:
 WINDOWS_PER_BATCH = 8
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=16)
 def _replay_fn(window: int, n_lines: int, pos_dtype_name: str):
     pdt = jnp.dtype(pos_dtype_name)
 
@@ -178,7 +178,7 @@ def _replay_ids(ids: np.ndarray, n_lines: int, n: int,
         valid = np.ones(batch, bool)
         if pad:
             chunk = np.concatenate([chunk, np.zeros(pad, np.int32)])
-            valid[len(chunk) - pad:] = False
+            valid[batch - pad:] = False
         last_pos, hist = fn(
             last_pos, hist, pdt.type(lo),
             jnp.asarray(chunk.reshape(WINDOWS_PER_BATCH, window)),
