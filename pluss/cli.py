@@ -83,7 +83,13 @@ def banner_of(backend: str) -> str:
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="pluss", description=__doc__)
-    p.add_argument("mode", choices=("acc", "speed", "mrc", "trace"))
+    p.add_argument("mode", choices=("acc", "speed", "mrc", "trace", "sweep"))
+    p.add_argument("--sweep-threads", default="1,2,4,8",
+                   help="sweep-mode thread counts (comma list)")
+    p.add_argument("--sweep-chunks", default="1,4,16",
+                   help="sweep-mode chunk sizes (comma list)")
+    p.add_argument("--cache-lines", default="512,4096,40960",
+                   help="sweep-mode cache sizes (lines) for the table")
     p.add_argument("--file", help="trace-mode input file of raw addresses")
     p.add_argument("--fmt", default="u64", choices=("u64", "text"),
                    help="trace file format (packed LE uint64 | text)")
@@ -148,6 +154,17 @@ def main(argv: list[str] | None = None) -> int:
         mrc.write_mrc(args.out, curve)
         out.write(f"wrote {len(mrc.dedup_lines(curve))} MRC lines to "
                   f"{args.out} (curve over {len(curve)} cache sizes)\n")
+    elif args.mode == "sweep":
+        # the tool's raison d'etre: predicted MRCs across parallel schedules
+        # (the reference rebuilds per -DTHREAD_NUM/-DCHUNK_SIZE combination)
+        from pluss import sweep as sweep_mod
+
+        ts = [int(x) for x in args.sweep_threads.split(",") if x]
+        cks = [int(x) for x in args.sweep_chunks.split(",") if x]
+        cls_ = [int(x) for x in args.cache_lines.split(",") if x]
+        pts = sweep_mod.sweep(spec, ts, cks, cfg, args.share_cap)
+        out.write(f"{spec.name}: predicted miss ratios\n")
+        out.write(sweep_mod.table(pts, cls_) + "\n")
     else:  # trace: dynamic replay (BASELINE config 5; bypasses CRI like the
         # reference's pluss_access path — see pluss/trace.py)
         if not args.file:

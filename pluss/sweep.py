@@ -1,0 +1,72 @@
+"""Schedule sweeps: predicted miss-ratio curves across parallel configs.
+
+PLUSS exists to answer "how will this loop nest's cache behavior change with
+the parallel schedule?" without running the program (the reference hardwires
+one config per build: ``-DTHREAD_NUM=4 -DCHUNK_SIZE=4``, ``c_lib/test/
+Makefile:13``).  Here the config is runtime data, so the question becomes one
+call: sample the nest under every (thread_num, chunk_size) candidate, run the
+CRI model and AET solver per config, and compare the curves.
+
+The engine caches one executable per config (``engine.compiled``), so a sweep
+costs one compile per *shape* family plus fast reruns — the TPU analogue of
+the reference rebuilding per `-D` combination.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from pluss import cri, engine, mrc
+from pluss.config import SHARE_CAP, SamplerConfig
+from pluss.spec import LoopNestSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepPoint:
+    """One (config, prediction) row of a sweep."""
+
+    cfg: SamplerConfig
+    curve: np.ndarray            # miss ratio per cache size (aet_mrc)
+    total_refs: int
+
+    def miss_ratio_at(self, cache_lines: int) -> float:
+        """Predicted miss ratio at a cache of ``cache_lines`` entries."""
+        if len(self.curve) == 0:
+            return 1.0
+        return float(self.curve[min(cache_lines, len(self.curve) - 1)])
+
+
+def sweep(spec: LoopNestSpec,
+          thread_nums: Sequence[int] = (1, 2, 4, 8),
+          chunk_sizes: Sequence[int] = (4,),
+          base_cfg: SamplerConfig = SamplerConfig(),
+          share_cap: int = SHARE_CAP) -> list[SweepPoint]:
+    """Predict the MRC of ``spec`` under each (thread_num, chunk_size)."""
+    out = []
+    for t in thread_nums:
+        for cs in chunk_sizes:
+            cfg = dataclasses.replace(base_cfg, thread_num=t, chunk_size=cs)
+            res = engine.run(spec, cfg, share_cap)
+            ri = cri.distribute(res.noshare_list(), res.share_list(), t)
+            out.append(SweepPoint(cfg, mrc.aet_mrc(ri, cfg),
+                                  res.max_iteration_count))
+    return out
+
+
+def table(points: Iterable[SweepPoint], cache_lines: Sequence[int]) -> str:
+    """Plain-text comparison table: one row per config, one column per cache
+    size (in lines), values = predicted miss ratio."""
+    heads = ["threads", "chunk"] + [f"mr@{c}" for c in cache_lines]
+    rows = [heads]
+    for p in points:
+        rows.append(
+            [str(p.cfg.thread_num), str(p.cfg.chunk_size)]
+            + [f"{p.miss_ratio_at(c):.4f}" for c in cache_lines]
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(len(heads))]
+    return "\n".join(
+        "  ".join(v.rjust(w) for v, w in zip(r, widths)) for r in rows
+    )

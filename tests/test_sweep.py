@@ -1,0 +1,42 @@
+"""Schedule sweeps: per-config predictions agree with direct runs."""
+
+import dataclasses
+
+import numpy as np
+
+from pluss import cri, engine, mrc, sweep
+from pluss.config import SamplerConfig
+from pluss.models import gemm
+
+
+def test_sweep_matches_direct_runs():
+    pts = sweep.sweep(gemm(16), thread_nums=(1, 4), chunk_sizes=(2,),
+                      base_cfg=SamplerConfig(cls=8))
+    assert [(p.cfg.thread_num, p.cfg.chunk_size) for p in pts] == [(1, 2), (4, 2)]
+    for p in pts:
+        res = engine.run(gemm(16), p.cfg)
+        ri = cri.distribute(res.noshare_list(), res.share_list(),
+                            p.cfg.thread_num)
+        want = mrc.aet_mrc(ri, p.cfg)
+        assert np.array_equal(p.curve, want)
+        assert p.total_refs == res.max_iteration_count
+        assert p.miss_ratio_at(0) == 1.0
+        assert p.miss_ratio_at(10**9) == p.curve[-1]
+
+
+def test_sweep_table_shape():
+    pts = sweep.sweep(gemm(16), thread_nums=(2,), chunk_sizes=(1, 4),
+                      base_cfg=SamplerConfig(cls=8))
+    txt = sweep.table(pts, [16, 256])
+    lines = txt.splitlines()
+    assert len(lines) == 3 and "mr@16" in lines[0] and "mr@256" in lines[0]
+
+
+def test_cli_sweep_mode(capsys):
+    from pluss import cli
+
+    cli.main(["sweep", "--n", "16", "--cpu", "--sweep-threads", "1,2",
+              "--sweep-chunks", "4", "--cache-lines", "64,1024"])
+    got = capsys.readouterr().out
+    assert "predicted miss ratios" in got and "mr@1024" in got
+    assert len(got.strip().splitlines()) == 4  # title + header + 2 rows
