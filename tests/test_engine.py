@@ -217,3 +217,23 @@ def test_nonzero_start_and_stride_matches_oracle():
     )
     assert_matches_oracle(spec, SamplerConfig(cls=8))
     assert_matches_oracle(spec, SamplerConfig(cls=8), window_accesses=32)
+
+
+def test_negative_step_matches_oracle():
+    # descending parallel loop (step<0): chunk bounds swap (lb<=ub in value
+    # space, sched.chunk_bounds), clocks and addresses must still agree
+    from pluss.spec import Loop, LoopNestSpec, Ref
+
+    spec = LoopNestSpec(
+        name="desc",
+        arrays=(("A", 200),),
+        nests=(
+            Loop(trip=8, start=14, step=-2, body=(
+                Ref("A0", "A", addr_terms=((0, 3),)),
+                Loop(trip=4, body=(
+                    Ref("A1", "A", addr_terms=((0, 2), (1, 5)), share_span=11),
+                )),
+            )),
+        ),
+    )
+    assert_matches_oracle(spec, SamplerConfig(cls=8))
