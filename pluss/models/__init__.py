@@ -6,9 +6,10 @@ reference's only shipped workload; the others cover the BASELINE.json configs
 """
 
 from pluss.models.gemm import gemm
-from pluss.models.linalg import atax, bicg, doitgen, gesummv, jacobi2d, mvt
+from pluss.models.linalg import (atax, bicg, doitgen, gemver, gesummv,
+                                 jacobi2d, mvt)
 from pluss.models.polybench import mm2, mm3, syrk
-from pluss.models.stencils import conv2d, stencil3d
+from pluss.models.stencils import conv2d, fdtd2d, heat3d, stencil3d
 
 REGISTRY = {
     "gemm": gemm,
@@ -23,9 +24,13 @@ REGISTRY = {
     "gesummv": gesummv,
     "doitgen": doitgen,
     "jacobi2d": jacobi2d,
+    "gemver": gemver,
+    "fdtd2d": fdtd2d,
+    "heat3d": heat3d,
 }
 
 __all__ = [
     "gemm", "mm2", "mm3", "syrk", "conv2d", "stencil3d",
-    "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d", "REGISTRY",
+    "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d",
+    "gemver", "fdtd2d", "heat3d", "REGISTRY",
 ]

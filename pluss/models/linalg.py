@@ -188,3 +188,45 @@ def jacobi2d(n: int = 64, tsteps: int = 2) -> LoopNestSpec:
         arrays=(("A", n * n), ("B", n * n)),
         nests=tuple(nests),
     )
+
+
+def gemver(n: int = 128) -> LoopNestSpec:
+    """gemver: rank-2 update ``A += u1 v1^T + u2 v2^T``, then ``x += beta
+    A^T y``, ``x += z``, ``w += alpha A x`` — four nests over one matrix."""
+    span = share_span_formula(n)
+    rank2 = Loop(trip=n, body=(
+        Loop(trip=n, body=(
+            Ref("A0", "A", addr_terms=((0, n), (1, 1))),
+            Ref("U10", "u1", addr_terms=((0, 1),)),
+            Ref("V10", "v1", addr_terms=((1, 1),), share_span=span),
+            Ref("U20", "u2", addr_terms=((0, 1),)),
+            Ref("V20", "v2", addr_terms=((1, 1),), share_span=span),
+            Ref("A1", "A", addr_terms=((0, n), (1, 1))),
+        )),
+    ))
+    xaty = Loop(trip=n, body=(
+        Loop(trip=n, body=(
+            Ref("A2", "A", addr_terms=((1, n), (0, 1))),
+            Ref("Y0", "y", addr_terms=((1, 1),), share_span=span),
+            Ref("X2", "x", addr_terms=((0, 1),)),
+            Ref("X3", "x", addr_terms=((0, 1),)),
+        )),
+    ))
+    xz = Loop(trip=n, body=(
+        Ref("X4", "x", addr_terms=((0, 1),)),
+        Ref("Z0", "z", addr_terms=((0, 1),)),
+        Ref("X5", "x", addr_terms=((0, 1),)),
+    ))
+    wax = Loop(trip=n, body=(
+        Loop(trip=n, body=(
+            Ref("A3", "A", addr_terms=((0, n), (1, 1))),
+            Ref("X6", "x", addr_terms=((1, 1),), share_span=span),
+            *_accum("w", ((0, 1),)),
+        )),
+    ))
+    return LoopNestSpec(
+        name=f"gemver{n}",
+        arrays=(("A", n * n), ("u1", n), ("v1", n), ("u2", n), ("v2", n),
+                ("x", n), ("y", n), ("z", n), ("w", n)),
+        nests=(rank2, xaty, xz, wax),
+    )

@@ -82,3 +82,72 @@ def stencil3d(n: int = 32) -> LoopNestSpec:
         arrays=(("out", m * m * m), ("in", n * n * n)),
         nests=(nest,),
     )
+
+
+def fdtd2d(n: int = 64, tsteps: int = 2) -> LoopNestSpec:
+    """fdtd-2d: per timestep, three interleaved sweeps over ey/ex/hz —
+    time-stepped multi-nest with halo reads (ppcg-style rectangular interior;
+    the boundary row/col updates of PolyBench's first loop are folded into
+    the interior sweeps for rectangularity)."""
+    m = n - 1
+    span = share_span_formula(m)
+    terms = ((0, n), (1, 1))
+    off = lambda di, dj: (di + 1) * n + (dj + 1)
+
+    def sweep(dst, srcs, t):
+        body = []
+        for nm, arr, (di, dj) in srcs:
+            body.append(Ref(f"{nm}{t}", arr, addr_terms=terms,
+                            addr_base=off(di, dj),
+                            share_span=span if di != 0 else None))
+        body.append(Ref(f"{dst}s{t}", dst, addr_terms=terms,
+                        addr_base=off(0, 0)))
+        return Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),))
+
+    nests = []
+    for t in range(tsteps):
+        nests.append(sweep("ey", (("eyc", "ey", (0, 0)),
+                                  ("hzm", "hz", (-1, 0))), t))
+        nests.append(sweep("ex", (("exc", "ex", (0, 0)),
+                                  ("hzj", "hz", (0, -1))), t))
+        nests.append(sweep("hz", (("hzc", "hz", (0, 0)),
+                                  ("exn", "ex", (0, 1)),
+                                  ("eyn", "ey", (1, 0))), t))
+    return LoopNestSpec(
+        name=f"fdtd2d{n}x{tsteps}",
+        arrays=(("ey", n * n), ("ex", n * n), ("hz", n * n)),
+        nests=tuple(nests),
+    )
+
+
+def heat3d(n: int = 24, tsteps: int = 2) -> LoopNestSpec:
+    """heat-3d: alternating 7-point sweeps A->B then B->A per timestep."""
+    m = n - 2
+    span = share_span_formula(m)
+    terms = ((0, n * n), (1, n), (2, 1))
+    off = lambda di, dj, dk: (di + 1) * n * n + (dj + 1) * n + (dk + 1)
+
+    def sweep(src, dst, t):
+        body = [Ref(f"{src}c{t}", src, addr_terms=terms,
+                    addr_base=off(0, 0, 0))]
+        for nm, d in (("mI", (-1, 0, 0)), ("pI", (1, 0, 0)),
+                      ("mJ", (0, -1, 0)), ("pJ", (0, 1, 0)),
+                      ("mK", (0, 0, -1)), ("pK", (0, 0, 1))):
+            body.append(Ref(f"{src}{nm}{t}", src, addr_terms=terms,
+                            addr_base=off(*d),
+                            share_span=span if d[0] != 0 else None))
+        body.append(Ref(f"{dst}o{t}", dst, addr_terms=terms,
+                        addr_base=off(0, 0, 0)))
+        return Loop(trip=m, body=(
+            Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),)),
+        ))
+
+    nests = []
+    for t in range(tsteps):
+        nests.append(sweep("A", "B", t))
+        nests.append(sweep("B", "A", t))
+    return LoopNestSpec(
+        name=f"heat3d{n}x{tsteps}",
+        arrays=(("A", n * n * n), ("B", n * n * n)),
+        nests=tuple(nests),
+    )

@@ -43,7 +43,8 @@ def test_gemm_odd_size_matches_oracle(cfg):
 
 @pytest.mark.parametrize(
     "name",
-    ["2mm", "3mm", "syrk", "conv2d", "atax", "mvt", "bicg", "gesummv"],
+    ["2mm", "3mm", "syrk", "conv2d", "atax", "mvt", "bicg", "gesummv",
+     "gemver"],
 )
 def test_other_kernels_match_oracle(name):
     assert_matches_oracle(REGISTRY[name](12), SamplerConfig(cls=8))
@@ -51,6 +52,14 @@ def test_other_kernels_match_oracle(name):
 
 def test_doitgen_matches_oracle():
     assert_matches_oracle(REGISTRY["doitgen"](6), SamplerConfig(cls=8))
+
+
+def test_fdtd2d_matches_oracle():
+    assert_matches_oracle(REGISTRY["fdtd2d"](8), SamplerConfig(cls=8))
+
+
+def test_heat3d_matches_oracle():
+    assert_matches_oracle(REGISTRY["heat3d"](6), SamplerConfig(cls=8))
 
 
 def test_jacobi2d_matches_oracle():
