@@ -96,60 +96,76 @@ def _tpl_dense(tpl, tid, d, n_lines, pos_dtype, nb):
     return head_pos, head_span, tail_pos
 
 
-def _device_segments(tid, pl: StreamPlan, share_cap: int, d, ultra_nests):
-    """One device's segments (one window per nest) for one simulated thread.
+def _nest_results(np_, ni: int, tids, pl: StreamPlan, share_cap: int, d):
+    """[T, ...] results of one nest's window on this device.
 
-    Returns per-nest stacked local results plus dense boundary arrays.
-    ``ultra_nests[ni]`` selects the static-template path (all windows clean,
-    decided at trace time) vs the sort path.
+    Each device holds window ``d`` of the nest.  When that window is clean
+    for every thread it takes the static-template path; otherwise it sorts.
+    The choice is per DEVICE: under ``shard_map`` (unlike ``vmap``)
+    ``lax.cond`` on the device index is a real branch, so ragged schedules
+    (odd trips, partial last rounds) only pay the sort on the devices that
+    own the unclean windows.  Static in-window share values of template
+    windows are added host-side in :func:`shard_run` (uncapped, like
+    ``engine.run``) — the template branch emits none.
     """
     cfg = pl.cfg
     bases = pl.spec.line_bases(cfg)
     n_lines = pl.spec.total_lines(cfg)
     pdt = jnp.dtype(pl.pos_dtype)
     nest_base = jnp.asarray(pl.nest_base.astype(pl.pos_dtype))
-    hists, svs, scs, snus, hps, hss, tps = [], [], [], [], [], [], []
-    for ni, np_ in enumerate(pl.nests):
-        if ultra_nests[ni]:
+
+    def tpl_all(_):
+        def one(t):
             tpl = np_.tpl
-            hp, hs, tp = _tpl_dense(tpl, tid, d, n_lines, pl.pos_dtype,
-                                    nest_base[ni, tid])
-            hists.append(jnp.asarray(tpl.local_hist.astype(pl.pos_dtype)))
-            # static in-window share values are added HOST-side in shard_run
-            # (uncapped, like engine.run) — the device emits none
-            svs.append(jnp.zeros((share_cap,), pdt))
-            scs.append(jnp.zeros((share_cap,), jnp.int32))
-            snus.append(jnp.int32(0))
-        else:
-            r0 = d * np_.window_rounds
-            owned_row = jnp.asarray(np_.owned)[tid]
+            hp, hs, tp = _tpl_dense(tpl, t, d, n_lines, pl.pos_dtype,
+                                    nest_base[ni, t])
+            return (jnp.asarray(tpl.local_hist.astype(pl.pos_dtype)),
+                    jnp.zeros((share_cap,), pdt),
+                    jnp.zeros((share_cap,), jnp.int32),
+                    jnp.int32(0), hp, hs, tp)
+        return jax.vmap(one)(tids)
+
+    def sort_all(_):
+        def one(t):
             key_s, pos_s, span_s, valid_i = window_stream(
-                np_, cfg, owned_row, r0, nest_base[ni, tid], bases,
+                np_, cfg, jnp.asarray(np_.owned)[t],
+                d * np_.window_rounds, nest_base[ni, t], bases,
                 pl.spec.array_index, pdt,
             )
             ev, _ = window_events(key_s, pos_s, span_s, valid_i, None)
-            hists.append(event_histogram(ev))
             sv, sc, snu = share_unique(ev, share_cap)
-            svs.append(sv); scs.append(sc); snus.append(snu)
             hp, hs, tp = boundary_arrays(key_s, pos_s, span_s, ev, n_lines)
-        hps.append(hp); hss.append(hs); tps.append(tp)
-    stack = lambda xs: jnp.stack(xs)
-    return (stack(hists), stack(svs), stack(scs), stack(snus),
-            stack(hps), stack(hss), stack(tps))
+            return (event_histogram(ev), sv, sc, snu, hp, hs, tp)
+        return jax.vmap(one)(tids)
+
+    if np_.tpl is None or np_.clean is None:
+        return sort_all(0)
+    mask = np_.clean.all(axis=0)          # [NW] bool, static
+    if mask.all():
+        return tpl_all(0)                 # common case: no sort branch at all
+    if not mask.any():
+        return sort_all(0)
+    # branch outputs mix device-invariant constants (template) with
+    # device-varying values (sort); unify the vma types for lax.cond
+    def _vary_leaf(y):
+        if "d" in getattr(jax.typeof(y), "vma", frozenset()):
+            return y
+        return jax.lax.pcast(y, ("d",), to="varying")
+
+    vary = lambda f: lambda x: jax.tree.map(_vary_leaf, f(x))
+    return jax.lax.cond(jnp.asarray(mask)[d], vary(tpl_all), vary(sort_all), 0)
 
 
 def _shard_body(tids, pl: StreamPlan, share_cap: int, D: int):
     d = jax.lax.axis_index("d")
     N = len(pl.nests)
-    # template path per nest iff every window of every thread is clean — a
-    # trace-time (static) condition, so the SPMD program stays uniform
-    ultra = tuple(
-        n.tpl is not None and n.clean is not None and bool(n.clean.all())
-        for n in pl.nests
+    per_nest = [
+        _nest_results(np_, ni, tids, pl, share_cap, d)
+        for ni, np_ in enumerate(pl.nests)
+    ]
+    (hist, sv, sc, snu, head_pos, head_span, tail_pos) = jax.tree.map(
+        lambda *xs: jnp.stack(xs, axis=1), *per_nest
     )
-    (hist, sv, sc, snu, head_pos, head_span, tail_pos) = jax.vmap(
-        lambda t: _device_segments(t, pl, share_cap, d, ultra)
-    )(tids)
     # tail exchange: [D, T, N, L] — the only cross-device state
     tails_all = jax.lax.all_gather(tail_pos, "d")
     ni_idx = jnp.arange(N)
@@ -232,13 +248,13 @@ def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         for t in range(T):
             for v in hv[dev, t][hv[dev, t] >= 0].tolist():
                 share_raw[t][v] = share_raw[t].get(v, 0) + 1
-    # static in-window share of template nests: one copy per (thread, window)
-    D = mesh.devices.size
+    # static in-window share of template nests: one copy per (thread, ultra
+    # window) — exactly the devices whose cond took the template branch
     from pluss.engine import add_static_share
 
     add_static_share(share_raw, [
-        (n, D if n.tpl is not None and n.clean is not None
-         and bool(n.clean.all()) else 0)
+        (n, int(n.clean.all(axis=0).sum())
+         if n.tpl is not None and n.clean is not None else 0)
         for n in pl.nests
     ])
     return SamplerResult(

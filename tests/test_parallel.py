@@ -93,3 +93,21 @@ def test_shard_ultra_template_path_matches_engine():
     assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
     assert a.share_list() == b.share_list()
     assert a.max_iteration_count == b.max_iteration_count
+
+
+def test_shard_mixed_clean_windows_per_device_branch():
+    # gemm(24) on 4 devices: rounds 0 (clean for all threads) and 1 (threads
+    # 2,3 idle) land on different devices, so template and sort branches run
+    # side by side in one SPMD program; results must match the engine
+    from pluss.engine import plan, run
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    cfg = SamplerConfig(cls=8)
+    pl = plan(gemm(24), cfg, n_windows=4)
+    n = pl.nests[0]
+    mask = n.clean.all(axis=0)
+    assert n.tpl is not None and mask.any() and not mask.all(), "precondition"
+    a = run(gemm(24), cfg)
+    b = shard_run(gemm(24), cfg, mesh=default_mesh(4))
+    assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
+    assert a.share_list() == b.share_list()
