@@ -115,6 +115,16 @@ class NestPlan:
     tpl: WindowTemplate | None = None      # static-window fast path
     clean: np.ndarray | None = None        # [T, NW] bool: window is clean
 
+    def ultra_windows(self) -> np.ndarray:
+        """[NW] bool: windows on the static-template path (clean for EVERY
+        thread, template available).  The single source of truth for path
+        selection AND the host-side static-share accounting — the template
+        path emits no in-window share events, so the two must agree exactly.
+        """
+        if self.tpl is None or self.clean is None:
+            return np.zeros(self.n_windows, bool)
+        return self.clean.all(axis=0)
+
 
 @dataclasses.dataclass(frozen=True, eq=False)
 class StreamPlan:
@@ -537,11 +547,7 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
         # windows processed in order as (ultra | sort) segments: a window
         # takes the static-template path only when it is clean for EVERY
         # thread (vmap runs threads in lockstep)
-        ultra_w = (
-            np_.clean.all(axis=0)
-            if ultra_step is not None
-            else np.zeros(np_.n_windows, bool)
-        )
+        ultra_w = np_.ultra_windows()
         segments: list[tuple[bool, list[int]]] = []
         for w in range(np_.n_windows):
             if segments and segments[-1][0] == bool(ultra_w[w]):
@@ -735,8 +741,7 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     # static in-window share events of ultra windows are host-side constants:
     # identical values and counts for every clean window of every thread
     add_static_share(share_raw,
-                     [(n, int(n.clean.all(axis=0).sum()) if n.tpl is not None
-                       else 0) for n in pl.nests])
+                     [(n, int(n.ultra_windows().sum())) for n in pl.nests])
     return SamplerResult(
         noshare_dense=np.asarray(hist, np.int64),
         share_raw=share_raw,

@@ -138,13 +138,11 @@ def _nest_results(np_, ni: int, tids, pl: StreamPlan, share_cap: int, d):
             return (event_histogram(ev), sv, sc, snu, hp, hs, tp)
         return jax.vmap(one)(tids)
 
-    if np_.tpl is None or np_.clean is None:
-        return sort_all(0)
-    mask = np_.clean.all(axis=0)          # [NW] bool, static
-    if mask.all():
-        return tpl_all(0)                 # common case: no sort branch at all
+    mask = np_.ultra_windows()            # [NW] bool, static
     if not mask.any():
         return sort_all(0)
+    if mask.all():
+        return tpl_all(0)                 # common case: no sort branch at all
     # branch outputs mix device-invariant constants (template) with
     # device-varying values (sort); unify the vma types for lax.cond
     def _vary_leaf(y):
@@ -250,13 +248,11 @@ def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                 share_raw[t][v] = share_raw[t].get(v, 0) + 1
     # static in-window share of template nests: one copy per (thread, ultra
     # window) — exactly the devices whose cond took the template branch
+    # (same ultra_windows() mask as the branch selection, by construction)
     from pluss.engine import add_static_share
 
-    add_static_share(share_raw, [
-        (n, int(n.clean.all(axis=0).sum())
-         if n.tpl is not None and n.clean is not None else 0)
-        for n in pl.nests
-    ])
+    add_static_share(share_raw,
+                     [(n, int(n.ultra_windows().sum())) for n in pl.nests])
     return SamplerResult(
         noshare_dense=np.asarray(hist, np.int64),
         share_raw=share_raw,
