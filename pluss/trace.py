@@ -10,10 +10,12 @@ TPU-native design: the same windowed sort-based extraction as the static
 engine (:mod:`pluss.ops.reuse`), fed by a *compacted* line-id stream instead of
 affine enumeration:
 
-1. Host pass: mask raw byte addresses to cache lines (``addr >> log2(CLS)``),
-   build the unique-line vocabulary incrementally per chunk (bounded memory),
-   and remap each chunk to dense ids — the TPU equivalent of the reference's
-   unbounded ``unordered_map`` LAT over raw lines.
+1. Host pass: mask raw byte addresses to cache lines (``addr >> log2(CLS)``)
+   and remap to dense ids — small line ranges map by offset directly; sparse
+   traces go through cluster probing (discovered memory regions with slack id
+   space; only cluster MISSES are ever sorted) — the TPU equivalent of the
+   reference's unbounded ``unordered_map`` LAT over raw lines, in bounded
+   memory.
 2. Device scan: ``lax.scan`` over fixed-size windows carrying
    ``last_pos[line]`` + the dense histogram, identical to the static path —
    arbitrarily long streams in bounded device memory (donated carry).
@@ -130,31 +132,61 @@ def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
         ids = (lines - lo_line).astype(np.int32)
         return _replay_ids(ids, int(hi_line - lo_line + 1), n, window)
 
-    # host compaction: incremental vocabulary over chunks, fully vectorized
-    # (sorted key array + parallel id array; ids are assignment-ordered and
-    # stay stable as the vocabulary grows)
-    keys_sorted = np.empty(0, np.int64)
-    ids_sorted = np.empty(0, np.int32)
-    next_id = 0
+    # host compaction by CLUSTER PROBING: real traces touch a few contiguous
+    # memory regions, so instead of a per-chunk sort into a line vocabulary,
+    # probe each chunk against the discovered cluster table (one searchsorted
+    # over ~dozens of clusters) and sort only the MISSES — which vanish once
+    # the working set is discovered.  A new cluster reserves `slack` id slots
+    # past its observed end so right-growth keeps already-assigned ids
+    # stable; ids are region offsets, so `n_lines` counts allocated table
+    # slots (>= touched lines).
+    slack = 1024
+    starts = np.empty(0, np.int64)   # cluster start line, sorted
+    widths = np.empty(0, np.int64)   # id slots allocated to the cluster
+    bases = np.empty(0, np.int64)    # cluster's first id
+    next_free = 0
     ids = np.empty(n, np.int32)
+
+    def map_into(chunk, out):
+        cl = np.searchsorted(starts, chunk, side="right") - 1
+        clc = np.maximum(cl, 0)
+        inside = (cl >= 0) & (chunk < starts[clc] + widths[clc])
+        out[inside] = (bases[clc] + (chunk - starts[clc]))[inside]
+        return inside
+
     for lo in range(0, n, window):
         chunk = lines[lo:lo + window]
-        uniq = np.unique(chunk)
-        pos = np.searchsorted(keys_sorted, uniq)
-        if len(keys_sorted):
-            hit = np.minimum(pos, len(keys_sorted) - 1)
-            is_new = keys_sorted[hit] != uniq
-        else:
-            is_new = np.ones(len(uniq), bool)
-        new_keys = uniq[is_new]
-        keys_sorted = np.insert(keys_sorted, pos[is_new], new_keys)
-        ids_sorted = np.insert(
-            ids_sorted, pos[is_new],
-            np.arange(next_id, next_id + len(new_keys), dtype=np.int32),
-        )
-        next_id += len(new_keys)
-        ids[lo:lo + window] = ids_sorted[np.searchsorted(keys_sorted, chunk)]
-    return _replay_ids(ids, next_id, n, window)
+        view = ids[lo:lo + window]
+        inside = map_into(chunk, view) if len(starts) else \
+            np.zeros(len(chunk), bool)
+        miss = chunk[~inside]
+        if not miss.size:
+            continue
+        mu = np.unique(miss)
+        brk = np.nonzero(np.diff(mu) > slack)[0] + 1
+        seg_s = mu[np.concatenate([[0], brk])]
+        seg_e = mu[np.concatenate([brk - 1, [len(mu) - 1]])]
+        for s, e in zip(seg_s.tolist(), seg_e.tolist()):
+            # clamp the slack so cluster ranges never overlap the next one
+            j = np.searchsorted(starts, s, side="right")
+            limit = int(starts[j]) if j < len(starts) else None
+            w = e - s + 1 + slack
+            if limit is not None:
+                w = min(w, limit - s)
+            starts = np.insert(starts, j, s)
+            widths = np.insert(widths, j, w)
+            bases = np.insert(bases, j, next_free)
+            next_free += w
+        sub = np.empty(miss.size, np.int32)
+        ok = map_into(miss, sub)
+        assert ok.all()
+        view[~inside] = sub
+        if next_free >= 1 << 31:
+            raise RuntimeError(
+                "trace line-id space exhausted; lines too fragmented for "
+                "cluster compaction"
+            )
+    return _replay_ids(ids, next_free, n, window)
 
 
 def _replay_ids(ids: np.ndarray, n_lines: int, n: int,
