@@ -47,7 +47,7 @@ def probe_accelerator() -> str | None:
 
 
 def native_baseline_s(n: int) -> float | None:
-    """Mean seconds/run of the native C++ sampler+CRI at size n, or None."""
+    """Best seconds/run of the native C++ sampler+CRI at size n, or None."""
     from pluss import native
 
     try:
@@ -67,7 +67,7 @@ def native_baseline_s(n: int) -> float | None:
         log(f"bench: native baseline run failed: {e}")
         return None
     times = [float(m) for m in re.findall(r"NATIVE C\+\+: ([0-9.]+)", out)]
-    return sum(times) / len(times) if times else None
+    return min(times) if times else None
 
 
 def main() -> int:
@@ -106,15 +106,17 @@ def main() -> int:
         t0 = time.perf_counter()
         step()
         times.append(time.perf_counter() - t0)
-    mean_s = sum(times) / len(times)
-    refs_per_sec = res.max_iteration_count / mean_s
+    # best-of-reps on BOTH sides: robust to transient host load, which would
+    # otherwise inflate (or deflate) the speedup ratio
+    best_s = min(times)
+    refs_per_sec = res.max_iteration_count / best_s
     log(f"bench: per-rep {['%.3f' % t for t in times]} s, "
-        f"{refs_per_sec:.3e} refs/s")
+        f"best {refs_per_sec:.3e} refs/s")
 
     base_s = native_baseline_s(n)
     vs = None
     if base_s:
-        vs = base_s / mean_s  # same workload, same count: speedup = time ratio
+        vs = base_s / best_s  # same workload, same count: speedup = time ratio
         log(f"bench: native C++ baseline {base_s:.3f} s/run -> speedup {vs:.2f}x")
 
     print(json.dumps({
