@@ -92,3 +92,20 @@ def test_random_specs_match_oracle(spec, cfg, window):
         assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
         want = {k: dict(v) for k, v in o.share[t].items() if v}
         assert r.share_dict(t) == want, f"tid {t} share"
+
+
+@settings(max_examples=10, deadline=None)
+@given(spec=specs(), cfg=configs())
+def test_random_specs_shard_matches_oracle(spec, cfg):
+    # the device-sharded backend (4-device virtual mesh: per-device
+    # template/sort branching, boundary exchange, psum merge) against the
+    # same oracle
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    o = OracleSampler(spec, cfg).run()
+    r = shard_run(spec, cfg, mesh=default_mesh(4))
+    assert r.max_iteration_count == o.max_iteration_count
+    for t in range(cfg.thread_num):
+        assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
+        want = {k: dict(v) for k, v in o.share[t].items() if v}
+        assert r.share_dict(t) == want, f"tid {t} share"
