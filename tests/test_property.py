@@ -86,7 +86,10 @@ def configs(draw):
 @given(spec=specs(), cfg=configs(), window=st.sampled_from([None, 64, 256]))
 def test_random_specs_match_oracle(spec, cfg, window):
     o = OracleSampler(spec, cfg).run()
-    r = run(spec, cfg, window_accesses=window)
+    _assert_result_matches(run(spec, cfg, window_accesses=window), o, cfg)
+
+
+def _assert_result_matches(r, o, cfg):
     assert r.max_iteration_count == o.max_iteration_count
     for t in range(cfg.thread_num):
         assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
@@ -103,9 +106,4 @@ def test_random_specs_shard_matches_oracle(spec, cfg):
     from pluss.parallel.shard import default_mesh, shard_run
 
     o = OracleSampler(spec, cfg).run()
-    r = shard_run(spec, cfg, mesh=default_mesh(4))
-    assert r.max_iteration_count == o.max_iteration_count
-    for t in range(cfg.thread_num):
-        assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
-        want = {k: dict(v) for k, v in o.share[t].items() if v}
-        assert r.share_dict(t) == want, f"tid {t} share"
+    _assert_result_matches(shard_run(spec, cfg, mesh=default_mesh(4)), o, cfg)
