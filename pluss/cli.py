@@ -41,15 +41,19 @@ from pluss.models import REGISTRY
 BACKENDS = ("vmap", "shard", "seq")
 
 
-def _sampler_of(backend: str, spec, cfg: SamplerConfig, share_cap: int):
+def _sampler_of(backend: str, spec, cfg: SamplerConfig, share_cap: int,
+                window: int | None = None, start_point: int | None = None):
     """() -> (result, rihist) closure for one backend."""
     if backend == "shard":
         from pluss.parallel.shard import default_mesh, shard_run
 
         mesh = default_mesh()
-        run_once = lambda: shard_run(spec, cfg, share_cap, mesh)
+        run_once = lambda: shard_run(spec, cfg, share_cap, mesh,
+                                     start_point=start_point)
     else:
-        run_once = lambda: engine.run(spec, cfg, share_cap, backend=backend)
+        run_once = lambda: engine.run(spec, cfg, share_cap,
+                                      start_point=start_point,
+                                      window_accesses=window, backend=backend)
 
     def step():
         res = run_once()
@@ -101,6 +105,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--chunk", type=int, default=4, help="schedule chunk size")
     p.add_argument("--reps", type=int, default=3, help="speed-mode repetitions")
     p.add_argument("--share-cap", type=int, default=SHARE_CAP)
+    p.add_argument("--window", type=int, default=None,
+                   help="scan-window size override (accesses per window)")
+    p.add_argument("--start-point", type=int, default=None,
+                   help="resume sampling from this parallel-loop iteration "
+                        "value (the reference's setStartPoint capability)")
     p.add_argument("--out", default="mrc.csv", help="mrc-mode output file")
     p.add_argument("--cpu", action="store_true",
                    help="force the host CPU backend (8 virtual devices)")
@@ -136,19 +145,22 @@ def main(argv: list[str] | None = None) -> int:
     out = sys.stdout
     if args.mode == "acc":
         for b in backends:
-            step = _sampler_of(b, spec, cfg, args.share_cap)
+            step = _sampler_of(b, spec, cfg, args.share_cap,
+                               args.window, args.start_point)
             step()  # warmup: exclude compilation from the timed region
             dt, res, ri = _timed(step, args.profile)
             acc_block(banner_of(b), dt, res.noshare_list(), res.share_list(),
                       ri, res.max_iteration_count, out)
     elif args.mode == "speed":
         for b in backends:
-            step = _sampler_of(b, spec, cfg, args.share_cap)
+            step = _sampler_of(b, spec, cfg, args.share_cap,
+                               args.window, args.start_point)
             step()  # warmup once per backend
             times = [_timed(step)[0] for _ in range(args.reps)]
             speed_block(banner_of(b), times, out)
     elif args.mode == "mrc":
-        step = _sampler_of(backends[0], spec, cfg, args.share_cap)
+        step = _sampler_of(backends[0], spec, cfg, args.share_cap,
+                           args.window, args.start_point)
         _, res, ri = _timed(step, args.profile)
         curve = mrc.aet_mrc(ri, cfg)
         mrc.write_mrc(args.out, curve)

@@ -124,3 +124,20 @@ def test_trace_mode(tmp_path, capsys):
     assert "TPU TRACE:" in got and "Start to dump reuse time" in got
     assert f"5000 refs over" in got
     assert out.read_text().startswith("miss ratio")
+
+
+def test_cli_window_and_start_point(capsys):
+    from pluss import cli
+
+    cli.main(["acc", "--cpu", "--n", "32", "--backends", "vmap",
+              "--window", "512", "--start-point", "16"])
+    got = capsys.readouterr().out
+    # iteration 16 sits in round 1: every thread skips round 0 entirely
+    total = int(got.strip().splitlines()[-1])
+    assert 0 < total < 32 * 32 * (2 + 4 * 32)
+    # and the count matches the engine with the same options
+    from pluss import engine
+    from pluss.models import gemm
+
+    want = engine.run(gemm(32), start_point=16, window_accesses=512)
+    assert total == want.max_iteration_count
