@@ -1,0 +1,17 @@
+/* Shim: <gsl/gsl_cdf.h> (pluss_utils.h:22) is only needed by the reference's
+ * #if 0-disabled geometric-CDF racetrack variant (pluss_utils.h:1132-1203);
+ * no live symbol is required.  Declared for completeness in case a build
+ * enables that region. */
+#ifndef PLUSS_TEST_GSL_CDF_SHIM_H
+#define PLUSS_TEST_GSL_CDF_SHIM_H
+
+#include <math.h>
+
+static inline double gsl_cdf_geometric_P(const unsigned int k, const double p)
+{
+    if (k < 1)
+        return 0.0;
+    return -expm1((double)k * log1p(-p));
+}
+
+#endif /* PLUSS_TEST_GSL_CDF_SHIM_H */

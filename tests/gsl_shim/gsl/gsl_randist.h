@@ -1,0 +1,30 @@
+/* Minimal GSL shim for building the reference sampler as a test oracle.
+ *
+ * The reference runtime (/root/reference/c_lib/test/runtime/pluss_utils.h:20-22)
+ * includes GSL for exactly one live call: gsl_ran_negative_binomial_pdf at
+ * pluss_utils.h:1002 (the NBD dilation).  GSL is not installed in this image,
+ * so we provide the same function here, computed the way GSL itself does
+ * (gsl_ran_negative_binomial_pdf in GSL's randist/nbinomial.c evaluates
+ * exp(lngamma terms) with the P(k) = Gamma(n+k)/(Gamma(k+1)Gamma(n))
+ * p^n (1-p)^k parameterization).  At the 6-significant-digit precision the
+ * reference prints (default std::cout), libm lgamma and GSL lngamma agree.
+ *
+ * This header exists so the ACTUAL reference binary can be compiled and run
+ * as an independent oracle; it contains no reference code.
+ */
+#ifndef PLUSS_TEST_GSL_RANDIST_SHIM_H
+#define PLUSS_TEST_GSL_RANDIST_SHIM_H
+
+#include <math.h>
+
+static inline double
+gsl_ran_negative_binomial_pdf(const unsigned int k, const double p,
+                              const double n)
+{
+    if (p <= 0.0 || p > 1.0 || n <= 0.0)
+        return 0.0;
+    return exp(lgamma(n + (double)k) - lgamma((double)k + 1.0) - lgamma(n)
+               + n * log(p) + (double)k * log1p(-p));
+}
+
+#endif /* PLUSS_TEST_GSL_RANDIST_SHIM_H */

@@ -1,0 +1,5 @@
+/* Shim: the reference includes <gsl/gsl_rng.h> (pluss_utils.h:20) but never
+ * uses any RNG symbol in live code.  Nothing to declare. */
+#ifndef PLUSS_TEST_GSL_RNG_SHIM_H
+#define PLUSS_TEST_GSL_RNG_SHIM_H
+#endif
