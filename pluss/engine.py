@@ -41,7 +41,10 @@ import numpy as np
 from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
 from pluss.ops.reuse import (
     bin_histogram,
+    carried_events,
     event_histogram,
+    extract_tails,
+    ghost_entries,
     log2_bin,
     share_mask,
     share_unique,
@@ -114,12 +117,18 @@ class NestPlan:
     n_windows: int            # NW
     tpl: WindowTemplate | None = None      # static-window fast path
     clean: np.ndarray | None = None        # [T, NW] bool: window is clean
+    #: refs of template-INELIGIBLE arrays: they run the device sort path in
+    #: every window, alongside the template (which covers the other refs).
+    #: Equal to ``refs`` when no template exists.
+    var_refs: tuple[FlatRef, ...] = ()
 
     def ultra_windows(self) -> np.ndarray:
         """[NW] bool: windows on the static-template path (clean for EVERY
         thread, template available).  The single source of truth for path
         selection AND the host-side static-share accounting — the template
-        path emits no in-window share events, so the two must agree exactly.
+        part of an ultra window emits no device-side in-window share events
+        for its (eligible) arrays, so the two must agree exactly.
+        ``var_refs`` arrays emit device share events in every window.
         """
         if self.tpl is None or self.clean is None:
             return np.zeros(self.n_windows, bool)
@@ -211,29 +220,40 @@ def _np_ref_window(fr: FlatRef, np_rounds: int, cfg: SamplerConfig, sched,
     return line.reshape(-1), pos.reshape(-1)
 
 
-def _static_perm_eligible(refs: tuple[FlatRef, ...], sched,
-                          cfg: SamplerConfig) -> bool:
-    """Shift-invariance of the window sort order across threads and windows.
+def _split_ref_groups(refs: tuple[FlatRef, ...], sched,
+                      cfg: SamplerConfig) -> tuple[tuple[FlatRef, ...],
+                                                   tuple[FlatRef, ...]]:
+    """Partition refs BY ARRAY into (template-eligible, sort-path) groups.
 
-    Two conditions, checked per nest:
-    - every ref of the same array has the same parallel-dim address
-      coefficient (else their relative line order shifts between windows, as
-      in syrk's A[i][k] vs A[j][k]);
-    - each ref's per-chunk address shift lands on a whole number of cache
-      lines (``coef0 * CS * step * DS % CLS == 0``), so the floor division
-      to lines shifts rigidly.
-    Cross-array order is always rigid: line ids live in disjoint
-    [base, base+count) ranges.
+    Reuse analysis decomposes exactly by array — line-id ranges are disjoint,
+    so events, cold misses, and the carried ``last_pos`` slices of different
+    arrays never interact.  Shift-invariance of the window sort order (the
+    condition the static window template rests on) is therefore required only
+    *per array*:
+
+    - every ref of the array shares one parallel-dim address coefficient
+      (else their relative line order shifts between windows, as in syrk's
+      A[i][k] vs A[j][k]), and
+    - the per-chunk address shift lands on a whole number of cache lines
+      (``coef0 * CS * step * DS % CLS == 0``), so the floor division to
+      lines shifts rigidly.
+
+    Arrays failing either test drop to the device sort path ALONE (their
+    refs become ``NestPlan.var_refs``); the remaining arrays keep the
+    hoisted template.  Cross-array order is always rigid: line ids live in
+    disjoint [base, base+count) ranges, and each eligible array's lines
+    shift within its own range.
     """
+    bad: set[str] = set()
     coef_by_array: dict[str, int] = {}
     for fr in refs:
         c0 = fr.addr_coefs[0]
-        seen = coef_by_array.setdefault(fr.ref.array, c0)
-        if seen != c0:
-            return False
+        if coef_by_array.setdefault(fr.ref.array, c0) != c0:
+            bad.add(fr.ref.array)
         if (abs(c0 * cfg.chunk_size * sched.step) * cfg.ds) % cfg.cls:
-            return False
-    return True
+            bad.add(fr.ref.array)
+    return (tuple(fr for fr in refs if fr.ref.array not in bad),
+            tuple(fr for fr in refs if fr.ref.array in bad))
 
 
 def _clean_windows(owned: np.ndarray, W: int, NW: int, CS: int,
@@ -381,18 +401,23 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     iters = np.zeros((len(spec.nests), T), np.int64)
     for ni, (sched, refs, body, asg, owned, W, NW) in enumerate(geom):
         tpl = clean = None
+        var_refs = refs
         # custom chunk->thread maps break the linear cid progression the
         # shift-invariance argument rests on; the sort path handles them.
         # Oversize windows would make the host-side template analysis itself
         # the bottleneck — skip it and let the device sort.
-        if (asg is None and _static_perm_eligible(refs, sched, cfg)
-                and W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW):
-            clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
-            tpl = _build_template(
-                refs, W, cfg, sched, owned, clean, spec.line_bases(cfg),
-                spec.array_index, body,
-            )
-        nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean))
+        if asg is None and W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW:
+            tpl_refs, split_var = _split_ref_groups(refs, sched, cfg)
+            if tpl_refs:
+                clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
+                tpl = _build_template(
+                    tpl_refs, W, cfg, sched, owned, clean,
+                    spec.line_bases(cfg), spec.array_index, body,
+                )
+                if tpl is not None:
+                    var_refs = split_var
+        nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean,
+                              var_refs))
         for t in range(T):
             for cid in owned[t]:
                 if cid >= 0:
@@ -446,21 +471,81 @@ def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
     )
 
 
-def window_stream(np_: NestPlan, cfg: SamplerConfig, owned_row, r0, nest_base,
-                  bases, array_index, pdt):
-    """Sorted (key, pos, span, valid) stream of one nest window — the shared
-    enumeration step of the scan path and the device-sharded path."""
-    parts = [
+def _window_parts(np_: NestPlan, refs, cfg, owned_row, r0, nest_base, bases,
+                  array_index, pdt) -> list:
+    """Per-ref (line, pos, span, valid) blocks of one nest window — the
+    enumeration step shared by the scan path (:func:`_sort_window`, which
+    appends ghost blocks) and the device-sharded path
+    (:func:`window_stream`)."""
+    return [
         _ref_window(fr, np_, cfg, owned_row, r0, nest_base,
                     bases[array_index(fr.ref.array)], pdt)
-        for fr in np_.refs
+        for fr in refs
     ]
+
+
+def _sorted_parts(parts):
     return sort_stream(
         jnp.concatenate([p[0] for p in parts]),
         jnp.concatenate([p[1] for p in parts]),
         jnp.concatenate([p[2] for p in parts]),
         jnp.concatenate([p[3] for p in parts]),
     )
+
+
+def window_stream(np_: NestPlan, cfg: SamplerConfig, owned_row, r0, nest_base,
+                  bases, array_index, pdt, refs=None):
+    """Sorted (key, pos, span, valid) stream of one nest window — the
+    device-sharded path's enumeration (the scan path uses
+    :func:`_sort_window`, which merges the carry as ghost entries).
+
+    ``refs``: optional subset to enumerate (default: all of ``np_.refs``);
+    the sharded backend passes ``np_.var_refs`` for the sort part of a
+    template window."""
+    return _sorted_parts(_window_parts(
+        np_, np_.refs if refs is None else refs, cfg, owned_row, r0,
+        nest_base, bases, array_index, pdt,
+    ))
+
+
+def _array_ranges(refs, spec, cfg) -> tuple[tuple[int, int], ...]:
+    """Ascending (line_base, line_count) of the arrays the refs touch —
+    the ghost coverage a sort window needs (see ops.reuse.carried_events)."""
+    bases, counts = spec.line_bases(cfg), spec.line_counts(cfg)
+    idxs = sorted({spec.array_index(fr.ref.array) for fr in refs})
+    return tuple((bases[i], counts[i]) for i in idxs)
+
+
+def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
+                 array_index, pdt, last_pos, win_shift: int):
+    """One sort-path window over ``refs``, ghost-merged with the carry.
+
+    The carried ``last_pos`` slices of the covered arrays enter the sort as
+    ghost entries, so every access's predecessor is its sorted left
+    neighbor (no window-sized gather), and the updated carry is compacted
+    back out by a second 1-key sort (no window-sized scatter) — see
+    ops.reuse.{ghost_entries, carried_events, extract_tails}.
+
+    Returns ``(new_last_pos, hist_delta, ev)``; ``ev`` holds the window's
+    event arrays so the caller can combine share extraction with other
+    sources (the template path's head candidates).
+    """
+    r0 = w * np_.window_rounds
+    parts = _window_parts(np_, refs, cfg, owned_row, r0, nb, bases,
+                          array_index, pdt)
+    parts += [ghost_entries(last_pos[b:b + c], b, pdt) for b, c in ranges]
+    key_s, pos_s, span_s, valid_s = _sorted_parts(parts)
+    win_start = nb + w.astype(pdt) * win_shift
+    ev = carried_events(key_s, pos_s, span_s, valid_s, win_start)
+    hist_delta = event_histogram(ev)
+    tails = extract_tails(key_s, pos_s, valid_s, sum(c for _, c in ranges))
+    off = 0
+    for b, c in ranges:
+        last_pos = jax.lax.dynamic_update_slice(
+            last_pos, tails[off:off + c], (b,)
+        )
+        off += c
+    return last_pos, hist_delta, ev
 
 
 def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
@@ -476,16 +561,19 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
     for ni, np_ in enumerate(pl.nests):
         owned_row = jnp.asarray(np_.owned)[tid]
         nb = nest_base[ni, tid]
+        win_shift = np_.window_rounds * cfg.chunk_size * np_.body
+        all_ranges = _array_ranges(np_.refs, pl.spec, cfg)
+        var_ranges = _array_ranges(np_.var_refs, pl.spec, cfg)
 
-        def sort_step(carry, w, np_=np_, owned_row=owned_row, nb=nb):
+        def sort_step(carry, w, np_=np_, owned_row=owned_row, nb=nb,
+                      win_shift=win_shift, all_ranges=all_ranges):
             last_pos, hist = carry
-            stream = window_stream(np_, cfg, owned_row,
-                                   w * np_.window_rounds, nb, bases,
-                                   pl.spec.array_index, pdt)
-            ev, last_pos = window_events(*stream, last_pos)
-            hist = hist + event_histogram(ev)
+            last_pos, dh, ev = _sort_window(
+                np_, np_.refs, all_ranges, cfg, owned_row, w, nb, bases,
+                pl.spec.array_index, pdt, last_pos, win_shift,
+            )
             sv, sc, snu = share_unique(ev, share_cap)
-            return (last_pos, hist), (sv, sc, snu)
+            return (last_pos, hist + dh), (sv, sc, snu)
 
         if np_.tpl is not None:
             tpl = np_.tpl
@@ -501,11 +589,24 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
             units0 = tid - tpl.t0
             shift_w = jnp.asarray(tpl.pos_shift, pdt)
 
-            def ultra_step(carry, w, tpl=tpl, hline=hline, hpos=hpos,
+            def ultra_step(carry, w, np_=np_, tpl=tpl, hline=hline, hpos=hpos,
                            hspan=hspan, hdl=hdl, tline=tline,
                            tpos=tpos, tdl=tdl, lhist=lhist, hs_idx=hs_idx,
-                           units0=units0, shift_w=shift_w, nb=nb):
+                           units0=units0, shift_w=shift_w, nb=nb,
+                           owned_row=owned_row, win_shift=win_shift,
+                           var_ranges=var_ranges):
                 last_pos, hist = carry
+                # template-ineligible arrays run the sort path inside the
+                # clean window too; disjoint line ranges make the two
+                # updates order-independent
+                ev_var = None
+                if np_.var_refs:
+                    last_pos, dh_var, ev_var = _sort_window(
+                        np_, np_.var_refs, var_ranges, cfg, owned_row, w,
+                        nb, bases, pl.spec.array_index, pdt, last_pos,
+                        win_shift,
+                    )
+                    hist = hist + dh_var
                 units = (w - tpl.w0) * tpl.unit_w + units0
                 dpos = (w - tpl.w0).astype(pdt) * shift_w + nb
                 if tpl.head_runs is not None:
@@ -533,8 +634,18 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                         )
                 else:
                     last_pos = last_pos.at[tline + tdl * units].set(newv)
+                # share extraction over both sources: the template's
+                # share-capable head candidates + the var window's events
+                cand = []
                 if tpl.hs_idx.shape[0]:
-                    sub = {"reuse": reuse[hs_idx], "share": share[hs_idx]}
+                    cand.append((reuse[hs_idx], share[hs_idx]))
+                if ev_var is not None:
+                    cand.append((ev_var["reuse"], ev_var["share"]))
+                if cand:
+                    sub = {
+                        "reuse": jnp.concatenate([c[0] for c in cand]),
+                        "share": jnp.concatenate([c[1] for c in cand]),
+                    }
                     sv, sc, snu = share_unique(sub, share_cap)
                 else:
                     sv = jnp.zeros((share_cap,), reuse.dtype)
@@ -696,7 +807,17 @@ def add_static_share(share_raw: list[dict],
 
 def merge_share_windows(svals, scnts, snu, share_cap: int,
                         thread_num: int) -> list[dict]:
-    """Host-side merge of per-(thread, window) share uniques into raw dicts."""
+    """Host-side merge of per-(thread, window) share uniques into raw dicts.
+
+    Overflow detection is per *device-side* window: ``snu`` counts uniques
+    the sort path (and the var part of template windows) extracted on
+    device.  Template windows' static share values bypass this check — they
+    are added uncapped by :func:`add_static_share` — so the same spec can
+    trip the cap on sort-path windows while its clean windows never do.
+    That asymmetry is safe (static values are exact, not capped) but means
+    a cap sized for the template path alone may still raise here when a
+    ragged schedule sends a window down the sort path.
+    """
     out: list[dict] = [dict() for _ in range(thread_num)]
     for ni in range(len(svals)):
         sv = np.asarray(svals[ni])

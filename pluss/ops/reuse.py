@@ -153,6 +153,75 @@ def window_events(key_s, pos_s, span_s, valid_i, last_pos):
     }, new_last_pos
 
 
+def carried_events(key_s, pos_s, span_s, valid_i, win_start):
+    """Reuse events of a ghost-merged sorted window.
+
+    The window stream is sorted *together with one ghost entry per line*
+    carrying the line's ``last_pos`` value (or -1 if untouched) — see
+    :func:`ghost_entries`.  Each ghost sorts to the head of its line's
+    segment (its position predates the window), so EVERY real access finds
+    its predecessor — carried or in-window — as its left neighbor, and the
+    whole carry resolution costs one subtraction instead of a window-sized
+    gather from the dense table (TPUs gather at ~1e8/s; the sort absorbs
+    the ghosts at +lines/window cost).
+
+    ``win_start`` is the smallest possible stream position of the window;
+    entries below it are ghosts.  Requires ghost coverage of every line the
+    window can touch: then a real access always has a same-line left
+    neighbor.  The ``same`` guard below re-checks that invariant — a stream
+    missing ghosts would otherwise silently pair a segment head with the
+    previous line's last entry (with the guard it undercounts instead,
+    which the differential tests catch loudly).
+    """
+    real = valid_i.astype(bool) & (pos_s >= win_start)
+    same = jnp.concatenate([jnp.zeros((1,), bool), key_s[1:] == key_s[:-1]])
+    prev_pos = jnp.concatenate([pos_s[:1], pos_s[:-1]])
+    is_evt = real & same & (prev_pos >= 0)
+    cold = real & same & (prev_pos < 0)
+    reuse = jnp.where(is_evt, pos_s - prev_pos, 0)
+    share = is_evt & share_mask(reuse, span_s)
+    return {
+        "reuse": reuse.astype(pos_s.dtype),
+        "is_evt": is_evt,
+        "share": share,
+        "cold": cold,
+    }
+
+
+def extract_tails(key_s, pos_s, valid_i, n_lines: int):
+    """New ``last_pos`` values of a ghost-merged sorted window, in line order.
+
+    The last entry of each line's segment is the line's latest position —
+    a real tail access, or the ghost itself when the window left the line
+    untouched (then the carried value passes through unchanged).  Selecting
+    them with a 1-key sort (segment-last entries keep their line id, all
+    others get the sentinel) compacts exactly one value per covered line,
+    in ascending line order: the first ``n_lines`` payload slots ARE the
+    updated dense table.  This replaces a window-sized scatter (serialized
+    on TPU) with a second cheap sort.
+    """
+    seg_last = jnp.concatenate([key_s[1:] != key_s[:-1],
+                                jnp.ones((1,), bool)])
+    k2 = jnp.where(seg_last & valid_i.astype(bool), key_s, LINE_SENTINEL)
+    _, p2 = jax.lax.sort((k2, pos_s), num_keys=1)
+    return p2[:n_lines]
+
+
+def ghost_entries(last_pos, line0: int, pdt):
+    """(line, pos, span, valid) ghost block for lines [line0, line0+len).
+
+    ``pos`` is the carried table slice itself — no gather; ``span`` 0 (ghosts
+    never classify events), ``valid`` all True (ghosts must participate in
+    the sort so they can head their segments)."""
+    n = last_pos.shape[0]
+    return (
+        (line0 + jnp.arange(n, dtype=jnp.int32)),
+        last_pos.astype(pdt),
+        jnp.zeros((n,), jnp.int32),
+        jnp.ones((n,), bool),
+    )
+
+
 def bin_histogram(bins: jnp.ndarray, wgt: jnp.ndarray,
                   num_segments: int = NBINS) -> jnp.ndarray:
     """[num_segments] histogram of 0/1 weights — one-hot matmul on the MXU.
@@ -216,15 +285,19 @@ def share_unique(ev: dict, cap: int):
     sv = jax.lax.sort(sv)
     is_evt = sv != sent
     boundary = jnp.concatenate([is_evt[:1], (sv[1:] != sv[:-1]) & is_evt[1:]])
-    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    seg = jnp.where(is_evt, seg, cap)  # padding -> overflow slot
-    counts = bin_histogram(seg, is_evt.astype(jnp.int32), cap + 1)[:cap]
-    # segment b's value sits at the start offset of its sorted run — a
-    # cap-sized gather instead of a stream-sized scatter (serialized on TPU)
-    starts = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]]
-    )
+    # unique b starts at the b-th boundary index; compact the first cap+1 of
+    # them with a second 1-operand sort (cheaper than the cumsum +
+    # segment-histogram alternative), then counts are adjacent differences,
+    # the last segment capped by the total event count
     n = sv.shape[0]
+    idx = jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), n)
+    idx_s = jax.lax.sort(idx)
+    if n < cap + 1:  # tiny windows: pad so the fixed-cap slices exist
+        idx_s = jnp.concatenate([idx_s, jnp.full((cap + 1 - n,), n, jnp.int32)])
+    starts = idx_s[:cap]
+    total = is_evt.sum().astype(jnp.int32)
+    ends = jnp.minimum(idx_s[1:cap + 1], total)
+    counts = jnp.where(starts < n, ends - starts, 0)
     vals = jnp.where(counts > 0, sv[jnp.minimum(starts, n - 1)], 0)
     n_unique = boundary.sum().astype(jnp.int32)
     return vals, counts, n_unique

@@ -119,10 +119,31 @@ def _nest_results(np_, ni: int, tids, pl: StreamPlan, share_cap: int, d):
             tpl = np_.tpl
             hp, hs, tp = _tpl_dense(tpl, t, d, n_lines, pl.pos_dtype,
                                     nest_base[ni, t])
-            return (jnp.asarray(tpl.local_hist.astype(pl.pos_dtype)),
-                    jnp.zeros((share_cap,), pdt),
-                    jnp.zeros((share_cap,), jnp.int32),
-                    jnp.int32(0), hp, hs, tp)
+            hist0 = jnp.asarray(tpl.local_hist.astype(pl.pos_dtype))
+            if np_.var_refs:
+                # template-ineligible arrays sort inside the clean window
+                # too (engine._split_ref_groups); their lines are disjoint
+                # from the template's, so the dense boundary arrays merge
+                # with a simple where
+                key_s, pos_s, span_s, valid_i = window_stream(
+                    np_, cfg, jnp.asarray(np_.owned)[t],
+                    d * np_.window_rounds, nest_base[ni, t], bases,
+                    pl.spec.array_index, pdt, refs=np_.var_refs,
+                )
+                ev, _ = window_events(key_s, pos_s, span_s, valid_i, None)
+                sv, sc, snu = share_unique(ev, share_cap)
+                vhp, vhs, vtp = boundary_arrays(key_s, pos_s, span_s, ev,
+                                                n_lines)
+                hist0 = hist0 + event_histogram(ev)
+                vset = vhp >= 0
+                hp = jnp.where(vset, vhp, hp)
+                hs = jnp.where(vset, vhs, hs)
+                tp = jnp.where(vtp >= 0, vtp, tp)
+            else:
+                sv = jnp.zeros((share_cap,), pdt)
+                sc = jnp.zeros((share_cap,), jnp.int32)
+                snu = jnp.int32(0)
+            return (hist0, sv, sc, snu, hp, hs, tp)
         return jax.vmap(one)(tids)
 
     def sort_all(_):

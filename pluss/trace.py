@@ -105,8 +105,11 @@ def _replay_fn(window: int, n_lines: int, pos_dtype_name: str):
         )
         return last_pos, hist
 
-    # donating the carry keeps last_pos/hist in place on device across batches
-    return jax.jit(run, donate_argnums=(0, 1))
+    # donating the carry keeps last_pos/hist in place on device across
+    # batches; the CPU backend does not support donation and would warn once
+    # per batch, so donate only off-CPU (there the copy is cheap anyway)
+    donate = (0, 1) if jax.default_backend() != "cpu" else ()
+    return jax.jit(run, donate_argnums=donate)
 
 
 def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,

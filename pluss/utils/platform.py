@@ -34,17 +34,27 @@ def force_cpu(n_virtual_devices: int | None = None) -> None:
     import jax
     from jax._src import xla_bridge
 
-    if (n_virtual_devices is not None
-            and xla_bridge.backends_are_initialized()
-            and len(jax.devices()) < n_virtual_devices):
+    if n_virtual_devices is not None and xla_bridge.backends_are_initialized():
         # XLA parses --xla_force_host_platform_device_count ONCE per process;
         # clearing backends does not re-read it, so growth cannot work —
-        # fail loudly instead of silently serving a smaller mesh
-        raise RuntimeError(
-            f"{len(jax.devices())} virtual devices already initialized; "
-            f"cannot grow to {n_virtual_devices} in this process (XLA reads "
-            "the device-count flag once). Request the largest count first."
-        )
+        # fail loudly instead of silently serving a smaller mesh.  An
+        # already-initialized NON-cpu backend hides the same trap: its device
+        # count says nothing about how many virtual CPU devices the
+        # once-parsed flag will yield after the switch.
+        if jax.default_backend() != "cpu":
+            raise RuntimeError(
+                f"backend {jax.default_backend()!r} already initialized; the "
+                f"CPU host-device-count flag ({n_virtual_devices}) can no "
+                "longer take effect in this process. Call force_cpu before "
+                "any jax operation."
+            )
+        if len(jax.devices()) < n_virtual_devices:
+            raise RuntimeError(
+                f"{len(jax.devices())} virtual devices already initialized; "
+                f"cannot grow to {n_virtual_devices} in this process (XLA "
+                "reads the device-count flag once). Request the largest "
+                "count first."
+            )
     if jax.config.jax_platforms != "cpu":
         jax.config.update("jax_platforms", "cpu")
         if xla_bridge.backends_are_initialized():

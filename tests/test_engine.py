@@ -152,16 +152,26 @@ def test_mixed_ultra_sort_segments_matches_oracle():
 
 
 def test_static_perm_eligibility():
-    """Fast (host-permutation) path activates exactly where the
-    shift-invariance conditions hold."""
+    """Fast (host-permutation) path activates exactly where the per-array
+    shift-invariance conditions hold (engine._split_ref_groups)."""
     from pluss.engine import plan
     from pluss.models import REGISTRY
 
-    assert plan(gemm(16)).nests[0].tpl is not None
-    # syrk reads A with two different parallel-dim coefficients -> sort path
-    assert plan(REGISTRY["syrk"](16)).nests[0].tpl is None
-    # odd N: per-chunk shift not a whole number of cache lines -> sort path
-    assert plan(gemm(13)).nests[0].tpl is None
+    full = plan(gemm(16)).nests[0]
+    assert full.tpl is not None and full.var_refs == ()
+    # syrk reads A with two different parallel-dim coefficients: A's refs
+    # drop to the sort path alone, C keeps the template
+    syrk = plan(REGISTRY["syrk"](16)).nests[0]
+    assert syrk.tpl is not None
+    assert {fr.ref.array for fr in syrk.var_refs} == {"A"}
+    assert all(fr.ref.array == "C"
+               for fr in syrk.refs if fr not in syrk.var_refs)
+    # odd N: the per-chunk shift of C and A is not a whole number of cache
+    # lines -> they sort; B (parallel-dim coefficient 0, shift 0) still
+    # templates
+    odd = plan(gemm(13)).nests[0]
+    assert odd.tpl is not None
+    assert {fr.ref.array for fr in odd.var_refs} == {"C", "A"}
     # custom assignment breaks the linear cid progression -> sort path
     assert plan(gemm(16), assignment=((0, 1, 2, 3),)).nests[0].tpl is None
 

@@ -111,3 +111,19 @@ def test_shard_mixed_clean_windows_per_device_branch():
     b = shard_run(gemm(24), cfg, mesh=default_mesh(4))
     assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
     assert a.share_list() == b.share_list()
+
+
+def test_shard_var_refs_in_template_window():
+    # syrk: A's two parallel-dim coefficients make it template-ineligible
+    # (engine._split_ref_groups), so clean shard windows run the template for
+    # C AND the var sort part for A; the dense boundary arrays of the two
+    # merge by disjoint line ranges (shard._nest_results tpl_all)
+    from pluss.engine import plan
+
+    cfg = SamplerConfig()
+    spec = REGISTRY["syrk"](64)
+    pl = plan(spec, cfg, n_windows=4)
+    n = pl.nests[0]
+    assert n.tpl is not None and n.var_refs, "precondition: split groups"
+    assert n.ultra_windows().any(), "precondition: template branch taken"
+    assert_same(shard_run(spec, cfg, mesh=default_mesh(4)), run(spec, cfg))
