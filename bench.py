@@ -123,6 +123,69 @@ def native_syrk_s(n: int, reps: int = 2) -> float | None:
     return min(times)
 
 
+def synth_trace(path: str, n_refs: int, seed: int = 0) -> None:
+    """Write a synthetic DynamoRIO-like byte-address trace (packed LE u64).
+
+    Two-tier working set (hot 2^16 lines / warm 2^22 lines, shuffled per
+    batch) — gives a two-knee MRC and a realistic reuse mix.  Written in
+    128 MB batches so generation is memory-bounded at any n_refs.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    batch = 1 << 24
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        written = 0
+        while written < n_refs:
+            m = min(batch, n_refs - written)
+            hot = rng.integers(0, 1 << 16, m // 2, dtype=np.int64)
+            warm = rng.integers(0, 1 << 22, m - m // 2, dtype=np.int64)
+            lines = np.concatenate([hot, warm])
+            rng.shuffle(lines)
+            (lines.astype(np.uint64) << np.uint64(6)).astype("<u8").tofile(f)
+            written += m
+    os.replace(tmp, path)
+
+
+def bench_trace(n_refs: int) -> None:
+    """BASELINE config 5: dynamic trace replay at 1e9 refs, streamed from
+    disk (pluss.trace.replay_file) vs the native replay_trace on the same
+    addresses.  The trace file is generated once and cached in .bench/."""
+    from pluss import native, trace
+
+    os.makedirs(".bench", exist_ok=True)
+    path = f".bench/trace_{n_refs}.bin"
+    if not (os.path.exists(path) and os.path.getsize(path) == 8 * n_refs):
+        log(f"bench: generating {n_refs}-ref synthetic trace at {path}")
+        t0 = time.perf_counter()
+        synth_trace(path, n_refs)
+        log(f"bench: trace generated in {time.perf_counter() - t0:.1f}s")
+    # warmup on a short prefix: the prefix discovers the same working set,
+    # so the full run below hits the jit cache at the same table shape.
+    # (One full timed run, not best-of-N: the tunneled TPU's throughput
+    # varies several-fold over minutes, so N runs at this scale could eat
+    # the whole bench budget without improving the estimate.)
+    t0 = time.perf_counter()
+    warm = trace.replay_file(path, limit_refs=32 * (1 << 20))
+    log(f"bench: trace warmup (incl. compile) {time.perf_counter() - t0:.2f}s"
+        f" over {warm.total_count} prefix refs")
+    t0 = time.perf_counter()
+    rep = trace.replay_file(path)
+    best_s = time.perf_counter() - t0
+    log(f"bench: {rep.total_count} refs over {rep.n_lines} line slots")
+    base_s = None
+    try:
+        if native.available(autobuild=True):
+            addrs = trace.load_trace(path)  # host RAM; excluded from timing
+            t0 = time.perf_counter()
+            native.replay(addrs)
+            base_s = time.perf_counter() - t0
+    except (RuntimeError, MemoryError) as e:
+        log(f"bench: native trace baseline unavailable: {e}")
+    emit(f"trace{n_refs}_replay_refs_per_sec", n_refs, best_s, base_s)
+
+
 def main() -> int:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
     plat = probe_accelerator()
@@ -154,6 +217,14 @@ def main() -> int:
         best_s, res = timed_reps(step_of(syrk(n_syrk)), 2, f"syrk{n_syrk}")
         emit(f"syrk{n_syrk}_sortpath_refs_per_sec", res.max_iteration_count,
              best_s, native_syrk_s(n_syrk))
+
+        # trace-replay metric (VERDICT r1 weak #4 / BASELINE config 5):
+        # 1e9 refs streamed from disk through the device scan
+        try:
+            bench_trace(int(os.environ.get("PLUSS_BENCH_TRACE_REFS",
+                                           1_000_000_000)))
+        except Exception as e:  # never let the aux metric sink the headline
+            log(f"bench: trace metric failed: {e}")
 
         # headline (LAST): BASELINE.json config 2, GEMM 1024^3 (4.3e9 refs)
         n, metric = 1024, "gemm1024_sampler_refs_per_sec"

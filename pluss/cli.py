@@ -187,9 +187,10 @@ def main(argv: list[str] | None = None) -> int:
         from pluss import trace as trace_mod
         from pluss.io import print_histogram
 
-        addrs = trace_mod.load_trace(args.file, args.fmt)
+        # u64 files stream from disk in bounded memory (64 MB batches);
+        # text files are small by nature and go through the in-memory path
         t0 = time.perf_counter()
-        rep = trace_mod.replay(addrs, cls=cfg.cls)
+        rep = trace_mod.replay_file(args.file, args.fmt, cls=cfg.cls)
         dt = time.perf_counter() - t0
         out.write(f"TPU TRACE: {dt:0.6f}\n")
         print_histogram("Start to dump reuse time", rep.histogram(), out)

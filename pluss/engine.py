@@ -68,7 +68,7 @@ class WindowTemplate:
     """Static structure of one clean window, shared by ALL clean windows.
 
     The sampler's stream is fully deterministic, and under the
-    shift-invariance conditions of :func:`_static_perm_eligible` every clean
+    shift-invariance conditions of :func:`_split_ref_groups` every clean
     window of every thread is a *rigid shift* of every other: same (line, pos)
     sort order, same in-window reuse intervals, same share classification,
     same head/tail line structure — only absolute line ids and stream
@@ -297,7 +297,7 @@ def _build_template(refs, W, cfg, sched, owned, clean, bases, array_index,
             fr, W, cfg, sched, owned[t0], w0 * W,
             bases[array_index(fr.ref.array)],
         )
-        # line shift per unit chunk offset; integral by _static_perm_eligible
+        # line shift per unit chunk offset; integral by _split_ref_groups
         d = fr.addr_coefs[0] * sched.step * cfg.chunk_size * cfg.ds
         assert d % cfg.cls == 0
         lines.append(line)

@@ -77,17 +77,32 @@ class ReplayResult:
 WINDOWS_PER_BATCH = 8
 
 
+def _replay_fn(window: int, pos_dtype_name: str):
+    """Batched replay step.  Not keyed by the line-table size: ``jit``
+    retraces on a new ``last_pos`` shape, which is exactly what the
+    streaming path's geometric table growth needs."""
+    # the donation decision is backend-dependent, so the backend is part of
+    # the cache key — a force_cpu fallback after an accelerator run must not
+    # reuse a donating executable (and vice versa)
+    return _replay_fn_cached(window, pos_dtype_name, jax.default_backend())
+
+
 @functools.lru_cache(maxsize=16)
-def _replay_fn(window: int, n_lines: int, pos_dtype_name: str):
+def _replay_fn_cached(window: int, pos_dtype_name: str, backend: str):
     pdt = jnp.dtype(pos_dtype_name)
 
-    def run(last_pos, hist, base, ids, valid):
-        # ids, valid: [WINDOWS_PER_BATCH, window]; base: batch stream offset
+    def run(last_pos, hist, base, ids, n_valid):
+        # ids: [WINDOWS_PER_BATCH, window]; base: batch stream offset;
+        # n_valid: total stream length — padding is always the stream tail,
+        # so validity is just pos < n_valid (a scalar ships per batch instead
+        # of a [batch] bool array: on a 1-core host the numpy staging of big
+        # transfers starves the PJRT client thread and serializes the pipe)
         pos = (
             base
             + jnp.arange(WINDOWS_PER_BATCH, dtype=pdt)[:, None] * window
             + jnp.arange(window, dtype=pdt)[None, :]
         )
+        valid = pos < n_valid
 
         def step(carry, xs):
             last_pos, hist = carry
@@ -108,7 +123,7 @@ def _replay_fn(window: int, n_lines: int, pos_dtype_name: str):
     # donating the carry keeps last_pos/hist in place on device across
     # batches; the CPU backend does not support donation and would warn once
     # per batch, so donate only off-CPU (there the copy is cheap anyway)
-    donate = (0, 1) if jax.default_backend() != "cpu" else ()
+    donate = (0, 1) if backend != "cpu" else ()
     return jax.jit(run, donate_argnums=donate)
 
 
@@ -135,61 +150,84 @@ def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
         ids = (lines - lo_line).astype(np.int32)
         return _replay_ids(ids, int(hi_line - lo_line + 1), n, window)
 
-    # host compaction by CLUSTER PROBING: real traces touch a few contiguous
-    # memory regions, so instead of a per-chunk sort into a line vocabulary,
-    # probe each chunk against the discovered cluster table (one searchsorted
-    # over ~dozens of clusters) and sort only the MISSES — which vanish once
-    # the working set is discovered.  A new cluster reserves `slack` id slots
-    # past its observed end so right-growth keeps already-assigned ids
-    # stable; ids are region offsets, so `n_lines` counts allocated table
-    # slots (>= touched lines).
-    slack = 1024
-    starts = np.empty(0, np.int64)   # cluster start line, sorted
-    widths = np.empty(0, np.int64)   # id slots allocated to the cluster
-    bases = np.empty(0, np.int64)    # cluster's first id
-    next_free = 0
+    # host compaction by cluster probing; the compactor is incremental, so
+    # the whole-array path here is just the streaming path with one source
+    comp = _Compactor()
     ids = np.empty(n, np.int32)
+    for lo in range(0, n, window):
+        ids[lo:lo + window] = comp.map(lines[lo:lo + window])
+    return _replay_ids(ids, comp.next_free, n, window)
 
-    def map_into(chunk, out):
-        cl = np.searchsorted(starts, chunk, side="right") - 1
+
+class _Compactor:
+    """Incremental cluster-probing line→dense-id table.
+
+    Real traces touch a few contiguous memory regions, so instead of a
+    per-chunk sort into a line vocabulary, probe each chunk against the
+    discovered cluster table (one searchsorted over ~dozens of clusters) and
+    sort only the MISSES — which vanish once the working set is discovered.
+    A new cluster reserves ``slack`` id slots past its observed end so
+    right-growth keeps already-assigned ids stable; ids are region offsets,
+    so ``next_free`` counts allocated table slots (>= touched lines).
+    State persists across :meth:`map` calls — the streaming path feeds
+    disk batches through one instance.
+    """
+
+    def __init__(self, slack: int = 1024):
+        self.slack = slack
+        self.starts = np.empty(0, np.int64)   # cluster start line, sorted
+        self.widths = np.empty(0, np.int64)   # id slots allocated
+        self.bases = np.empty(0, np.int64)    # cluster's first id
+        self.next_free = 0
+
+    def _map_into(self, chunk, out):
+        cl = np.searchsorted(self.starts, chunk, side="right") - 1
         clc = np.maximum(cl, 0)
-        inside = (cl >= 0) & (chunk < starts[clc] + widths[clc])
-        out[inside] = (bases[clc] + (chunk - starts[clc]))[inside]
+        inside = (cl >= 0) & (chunk < self.starts[clc] + self.widths[clc])
+        out[inside] = (self.bases[clc] + (chunk - self.starts[clc]))[inside]
         return inside
 
-    for lo in range(0, n, window):
-        chunk = lines[lo:lo + window]
-        view = ids[lo:lo + window]
-        inside = map_into(chunk, view) if len(starts) else \
+    def map(self, chunk: np.ndarray) -> np.ndarray:
+        """Dense int32 ids of one chunk of line numbers (grows the table)."""
+        if len(self.starts) == 1:
+            # single discovered region (the common case once the working set
+            # stabilizes): containment is a min/max check and mapping is one
+            # vectorized subtract — ~6x cheaper than the general probe, which
+            # matters because the host core is shared with the PJRT client
+            s0 = int(self.starts[0])
+            if int(chunk.min()) >= s0 and int(chunk.max()) < s0 + int(self.widths[0]):
+                return (chunk - (s0 - int(self.bases[0]))).astype(np.int32)
+        out = np.empty(len(chunk), np.int32)
+        inside = self._map_into(chunk, out) if len(self.starts) else \
             np.zeros(len(chunk), bool)
         miss = chunk[~inside]
         if not miss.size:
-            continue
+            return out
         mu = np.unique(miss)
-        brk = np.nonzero(np.diff(mu) > slack)[0] + 1
+        brk = np.nonzero(np.diff(mu) > self.slack)[0] + 1
         seg_s = mu[np.concatenate([[0], brk])]
         seg_e = mu[np.concatenate([brk - 1, [len(mu) - 1]])]
         for s, e in zip(seg_s.tolist(), seg_e.tolist()):
             # clamp the slack so cluster ranges never overlap the next one
-            j = np.searchsorted(starts, s, side="right")
-            limit = int(starts[j]) if j < len(starts) else None
-            w = e - s + 1 + slack
+            j = np.searchsorted(self.starts, s, side="right")
+            limit = int(self.starts[j]) if j < len(self.starts) else None
+            w = e - s + 1 + self.slack
             if limit is not None:
                 w = min(w, limit - s)
-            starts = np.insert(starts, j, s)
-            widths = np.insert(widths, j, w)
-            bases = np.insert(bases, j, next_free)
-            next_free += w
+            self.starts = np.insert(self.starts, j, s)
+            self.widths = np.insert(self.widths, j, w)
+            self.bases = np.insert(self.bases, j, self.next_free)
+            self.next_free += w
         sub = np.empty(miss.size, np.int32)
-        ok = map_into(miss, sub)
+        ok = self._map_into(miss, sub)
         assert ok.all()
-        view[~inside] = sub
-        if next_free >= 1 << 31:
+        out[~inside] = sub
+        if self.next_free >= 1 << 31:
             raise RuntimeError(
                 "trace line-id space exhausted; lines too fragmented for "
                 "cluster compaction"
             )
-    return _replay_ids(ids, next_free, n, window)
+        return out
 
 
 def _replay_ids(ids: np.ndarray, n_lines: int, n: int,
@@ -202,7 +240,7 @@ def _replay_ids(ids: np.ndarray, n_lines: int, n: int,
         raise RuntimeError(
             f"trace of {n} accesses needs int64 positions; enable jax_enable_x64"
         )
-    fn = _replay_fn(window, n_lines, pos_dtype)
+    fn = _replay_fn(window, pos_dtype)
     pdt = np.dtype(pos_dtype)
     last_pos = jnp.full((n_lines,), -1, pdt)
     hist = jnp.zeros((NBINS,), pdt)
@@ -210,16 +248,82 @@ def _replay_ids(ids: np.ndarray, n_lines: int, n: int,
         lo = b * batch
         chunk = ids[lo:lo + batch]
         pad = batch - len(chunk)
-        valid = np.ones(batch, bool)
         if pad:
             chunk = np.concatenate([chunk, np.zeros(pad, np.int32)])
-            valid[batch - pad:] = False
         last_pos, hist = fn(
             last_pos, hist, pdt.type(lo),
             jnp.asarray(chunk.reshape(WINDOWS_PER_BATCH, window)),
-            jnp.asarray(valid.reshape(WINDOWS_PER_BATCH, window)),
+            pdt.type(n),
         )
     return ReplayResult(np.asarray(hist, np.int64), n, n_lines)
+
+
+def replay_file(path: str, fmt: str = "u64", cls: int = 64,
+                window: int = TRACE_WINDOW, precompacted: bool = False,
+                initial_capacity: int = 1 << 20,
+                limit_refs: int | None = None) -> ReplayResult:
+    """Replay a trace FILE in bounded host memory (BASELINE config 5 scale).
+
+    Unlike ``replay(load_trace(path))``, which slurps the whole file, this
+    streams disk batches (``WINDOWS_PER_BATCH * window`` addresses ≈ 64 MB
+    at the default window) through the incremental compactor straight into
+    the device scan, so a 1e9-ref / 8 GB trace replays without ever holding
+    more than one batch on the host.  The device line table starts at
+    ``initial_capacity`` ids and doubles as the compactor discovers the
+    working set (each growth retraces the jitted step — O(log) growths).
+    """
+    if fmt == "text":  # line-oriented; no random access worth streaming
+        return replay(load_trace(path, fmt), cls, window,
+                      precompacted=precompacted)
+    if fmt != "u64":
+        raise ValueError(f"unknown trace format {fmt!r}")
+    import os
+
+    n = os.path.getsize(path) // 8
+    if limit_refs is not None:
+        n = min(n, limit_refs)  # prefix replay (e.g. compile warmup)
+    if n == 0:
+        return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)
+    if cls & (cls - 1):
+        raise ValueError(f"cache line size {cls} is not a power of two")
+    shift = int(cls).bit_length() - 1
+    batch = WINDOWS_PER_BATCH * window
+    n_batches = -(-n // batch)
+    pos_dtype = "int32" if n_batches * batch < 2**31 - 2 else "int64"
+    if pos_dtype == "int64" and not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            f"trace of {n} accesses needs int64 positions; enable jax_enable_x64"
+        )
+    fn = _replay_fn(window, pos_dtype)
+    pdt = np.dtype(pos_dtype)
+    comp = _Compactor()
+    capacity = initial_capacity
+    last_pos = jnp.full((capacity,), -1, pdt)
+    hist = jnp.zeros((NBINS,), pdt)
+    with open(path, "rb") as f:
+        for b in range(n_batches):
+            # never read past n: a limit_refs prefix must not compact (or
+            # grow the device table with) addresses it will mask out anyway
+            raw = np.fromfile(f, dtype="<u8", count=min(batch, n - b * batch))
+            lines = raw.astype(np.int64) if precompacted \
+                else raw.astype(np.int64) >> shift
+            ids = comp.map(lines)
+            if comp.next_free > capacity:
+                while capacity < comp.next_free:
+                    capacity *= 2
+                last_pos = jnp.concatenate(
+                    [last_pos, jnp.full((capacity - last_pos.shape[0],),
+                                        -1, pdt)]
+                )
+            pad = batch - len(ids)
+            if pad:
+                ids = np.concatenate([ids, np.zeros(pad, np.int32)])
+            last_pos, hist = fn(
+                last_pos, hist, pdt.type(b * batch),
+                jnp.asarray(ids.reshape(WINDOWS_PER_BATCH, window)),
+                pdt.type(n),
+            )
+    return ReplayResult(np.asarray(hist, np.int64), n, comp.next_free)
 
 
 def load_trace(path: str, fmt: str = "u64") -> np.ndarray:

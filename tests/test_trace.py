@@ -93,3 +93,37 @@ def test_replay_dense_range_shortcut_offsets():
     res = trace.replay(addrs)
     assert res.n_lines == 3
     assert res.histogram() == oracle_replay(addrs)
+
+
+def test_replay_file_streams_matching_in_memory(tmp_path):
+    # sparse clusters + tiny window + tiny initial capacity: exercises the
+    # batched disk reads, the incremental compactor across batches, AND the
+    # geometric device-table growth (each growth retraces the jit)
+    rng = np.random.default_rng(11)
+    base = rng.integers(0, 1 << 40, 40, dtype=np.int64) * 64
+    addrs = base[rng.integers(0, 40, 6000)]
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    res = trace.replay_file(str(p), window=1 << 9, initial_capacity=8)
+    ref = trace.replay(addrs, window=1 << 9)
+    assert res.total_count == ref.total_count == 6000
+    assert res.histogram() == ref.histogram() == oracle_replay(addrs)
+
+
+def test_replay_file_partial_final_batch(tmp_path):
+    # length not a multiple of the batch: final batch is padded/masked
+    addrs = np.arange(100, dtype=np.int64) * 64
+    addrs = np.concatenate([addrs, addrs])  # every line reused once
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    res = trace.replay_file(str(p), window=64)
+    assert res.histogram() == oracle_replay(addrs)
+
+
+def test_replay_file_text_fallback(tmp_path):
+    pt = tmp_path / "t.txt"
+    pt.write_text("0\n64\n0\n")
+    res = trace.replay_file(str(pt), fmt="text")
+    assert res.histogram() == oracle_replay([0, 64, 0])
+    with pytest.raises(ValueError, match="unknown trace format"):
+        trace.replay_file(str(pt), fmt="bogus")
