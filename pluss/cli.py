@@ -47,12 +47,10 @@ def _sampler_of(backend: str, spec, cfg: SamplerConfig, share_cap: int,
     if backend == "shard":
         from pluss.parallel.shard import default_mesh, shard_run
 
-        if window is not None:
-            print("pluss: --window is ignored by the shard backend (its "
-                  "window count is the mesh size)", file=sys.stderr)
         mesh = default_mesh()
         run_once = lambda: shard_run(spec, cfg, share_cap, mesh,
-                                     start_point=start_point)
+                                     start_point=start_point,
+                                     window_accesses=window)
     else:
         run_once = lambda: engine.run(spec, cfg, share_cap,
                                       start_point=start_point,

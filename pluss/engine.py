@@ -349,6 +349,43 @@ def _build_template(refs, W, cfg, sched, owned, clean, bases, array_index,
     )
 
 
+def _nest_geometry(spec: LoopNestSpec, cfg: SamplerConfig, assignment,
+                   start_point, target: int):
+    """Per-nest (sched, refs, body, asg, owned, W_nat, NW_nat): schedules,
+    owned-chunk matrices, and the natural window split at ``target``
+    accesses/window — the single source of the window-sizing formula, shared
+    by :func:`plan` and :func:`natural_n_windows`."""
+    T = cfg.thread_num
+    out = []
+    for ni, nest in enumerate(spec.nests):
+        sched = ChunkSchedule(cfg.chunk_size, nest.trip, nest.start,
+                              nest.step, T)
+        refs = tuple(flatten_nest(nest))
+        body = nest_iteration_size(nest)
+        asg = assignment[ni] if assignment is not None else None
+        sp = start_point if ni == 0 else None
+        owned = _owned_matrix(sched, T, asg, sp)
+        R = owned.shape[1]
+        W = max(1, min(R, -(-target // (cfg.chunk_size * body))))
+        out.append((sched, refs, body, asg, owned, W, -(-R // W)))
+    return out
+
+
+def natural_n_windows(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
+                      assignment=None, start_point: int | None = None,
+                      window_accesses: int | None = None) -> int:
+    """Window count the engine would choose on its own (max over nests).
+
+    The sharded backend uses this to pick its sub-windows-per-device count:
+    windows stay near ``window_accesses`` (default WINDOW_TARGET) accesses
+    regardless of mesh size, so per-device sort memory is bounded by the
+    same target as the single-device scan.
+    """
+    geom = _nest_geometry(spec, cfg, assignment, start_point,
+                          window_accesses or WINDOW_TARGET)
+    return max(nw for *_, nw in geom)
+
+
 def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
          assignment: tuple[tuple[int, ...] | None, ...] | None = None,
          start_point: int | None = None,
@@ -360,25 +397,17 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     ``start_point``: resume iteration value applied to the first nest;
     ``window_accesses``: scan-window size override (default WINDOW_TARGET);
     ``n_windows``: force exactly this many equal round windows per nest (the
-    sharded backend maps one window per device).
+    sharded backend maps S sub-windows per device).
     """
     T = cfg.thread_num
-    target = window_accesses or WINDOW_TARGET
     geom = []  # (sched, refs, body, asg, owned, W, NW) per nest
-    for ni, nest in enumerate(spec.nests):
-        sched = ChunkSchedule(cfg.chunk_size, nest.trip, nest.start, nest.step, T)
-        refs = tuple(flatten_nest(nest))
-        body = nest_iteration_size(nest)
-        asg = assignment[ni] if assignment is not None else None
-        sp = start_point if ni == 0 else None
-        owned = _owned_matrix(sched, T, asg, sp)
+    for sched, refs, body, asg, owned, W, NW in _nest_geometry(
+            spec, cfg, assignment, start_point,
+            window_accesses or WINDOW_TARGET):
         R = owned.shape[1]
         if n_windows is not None:
             NW = n_windows
             W = -(-R // NW)
-        else:
-            W = max(1, min(R, -(-target // (cfg.chunk_size * body))))
-            NW = -(-R // W)
         pad = np.full((T, NW * W - R), -1, np.int32)
         geom.append((sched, refs, body, asg,
                      np.concatenate([owned, pad], axis=1), W, NW))
@@ -474,9 +503,9 @@ def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
 def _window_parts(np_: NestPlan, refs, cfg, owned_row, r0, nest_base, bases,
                   array_index, pdt) -> list:
     """Per-ref (line, pos, span, valid) blocks of one nest window — the
-    enumeration step shared by the scan path (:func:`_sort_window`, which
-    appends ghost blocks) and the device-sharded path
-    (:func:`window_stream`)."""
+    enumeration step of :func:`_sort_window` (which appends ghost blocks;
+    both the single-device scan and the sharded backend's sub-window scan
+    go through it)."""
     return [
         _ref_window(fr, np_, cfg, owned_row, r0, nest_base,
                     bases[array_index(fr.ref.array)], pdt)
@@ -493,21 +522,6 @@ def _sorted_parts(parts):
     )
 
 
-def window_stream(np_: NestPlan, cfg: SamplerConfig, owned_row, r0, nest_base,
-                  bases, array_index, pdt, refs=None):
-    """Sorted (key, pos, span, valid) stream of one nest window — the
-    device-sharded path's enumeration (the scan path uses
-    :func:`_sort_window`, which merges the carry as ghost entries).
-
-    ``refs``: optional subset to enumerate (default: all of ``np_.refs``);
-    the sharded backend passes ``np_.var_refs`` for the sort part of a
-    template window."""
-    return _sorted_parts(_window_parts(
-        np_, np_.refs if refs is None else refs, cfg, owned_row, r0,
-        nest_base, bases, array_index, pdt,
-    ))
-
-
 def _array_ranges(refs, spec, cfg) -> tuple[tuple[int, int], ...]:
     """Ascending (line_base, line_count) of the arrays the refs touch —
     the ghost coverage a sort window needs (see ops.reuse.carried_events)."""
@@ -517,7 +531,8 @@ def _array_ranges(refs, spec, cfg) -> tuple[tuple[int, int], ...]:
 
 
 def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
-                 array_index, pdt, last_pos, win_shift: int):
+                 array_index, pdt, last_pos, win_shift: int,
+                 with_hist: bool = True):
     """One sort-path window over ``refs``, ghost-merged with the carry.
 
     The carried ``last_pos`` slices of the covered arrays enter the sort as
@@ -526,9 +541,13 @@ def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
     back out by a second 1-key sort (no window-sized scatter) — see
     ops.reuse.{ghost_entries, carried_events, extract_tails}.
 
-    Returns ``(new_last_pos, hist_delta, ev)``; ``ev`` holds the window's
-    event arrays so the caller can combine share extraction with other
-    sources (the template path's head candidates).
+    Returns ``(new_last_pos, hist_delta, ev, (key_s, pos_s, span_s))``;
+    ``ev`` holds the window's event arrays so the caller can combine share
+    extraction with other sources (the template path's head candidates),
+    and the sorted arrays let the sharded backend capture device-level
+    heads.  ``with_hist=False`` skips the histogram (the sharded backend
+    builds its own, excluding cold — a device-local "cold" is just an
+    unresolved head there).
     """
     r0 = w * np_.window_rounds
     parts = _window_parts(np_, refs, cfg, owned_row, r0, nb, bases,
@@ -537,7 +556,7 @@ def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
     key_s, pos_s, span_s, valid_s = _sorted_parts(parts)
     win_start = nb + w.astype(pdt) * win_shift
     ev = carried_events(key_s, pos_s, span_s, valid_s, win_start)
-    hist_delta = event_histogram(ev)
+    hist_delta = event_histogram(ev) if with_hist else None
     tails = extract_tails(key_s, pos_s, valid_s, sum(c for _, c in ranges))
     off = 0
     for b, c in ranges:
@@ -545,7 +564,7 @@ def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
             last_pos, tails[off:off + c], (b,)
         )
         off += c
-    return last_pos, hist_delta, ev
+    return last_pos, hist_delta, ev, (key_s, pos_s, span_s)
 
 
 def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
@@ -568,7 +587,7 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
         def sort_step(carry, w, np_=np_, owned_row=owned_row, nb=nb,
                       win_shift=win_shift, all_ranges=all_ranges):
             last_pos, hist = carry
-            last_pos, dh, ev = _sort_window(
+            last_pos, dh, ev, _ = _sort_window(
                 np_, np_.refs, all_ranges, cfg, owned_row, w, nb, bases,
                 pl.spec.array_index, pdt, last_pos, win_shift,
             )
@@ -601,7 +620,7 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                 # updates order-independent
                 ev_var = None
                 if np_.var_refs:
-                    last_pos, dh_var, ev_var = _sort_window(
+                    last_pos, dh_var, ev_var, _ = _sort_window(
                         np_, np_.var_refs, var_ranges, cfg, owned_row, w,
                         nb, bases, pl.spec.array_index, pdt, last_pos,
                         win_shift,

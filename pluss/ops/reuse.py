@@ -251,24 +251,6 @@ def event_histogram(ev: dict) -> jnp.ndarray:
     return bin_histogram(bins, w)
 
 
-def boundary_arrays(key_s, pos_s, span_s, ev: dict, n_lines: int):
-    """Dense per-line (head_pos, head_span, tail_pos) of one sorted window.
-
-    Heads/tails are unique per line, so plain scatters suffice.  Untouched
-    lines hold -1.  The sharded backend gathers these across devices to resolve
-    cross-shard reuses (:mod:`pluss.parallel`).
-    """
-    head_t = jnp.where(ev["head"], key_s, n_lines)
-    tail_t = jnp.where(ev["tail"], key_s, n_lines)
-    init = jnp.full((n_lines,), -1, pos_s.dtype)
-    head_pos = init.at[head_t].set(pos_s, mode="drop")
-    head_span = jnp.full((n_lines,), 0, span_s.dtype).at[head_t].set(
-        span_s, mode="drop"
-    )
-    tail_pos = init.at[tail_t].set(pos_s, mode="drop")
-    return head_pos, head_span, tail_pos
-
-
 def share_unique(ev: dict, cap: int):
     """Fixed-capacity (value, count) extraction of raw share reuses.
 
