@@ -88,7 +88,10 @@ def banner_of(backend: str) -> str:
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="pluss", description=__doc__)
-    p.add_argument("mode", choices=("acc", "speed", "mrc", "trace", "sweep"))
+    p.add_argument("mode",
+                   choices=("acc", "speed", "mrc", "trace", "sweep", "sample"))
+    p.add_argument("--rates", default="0.05,0.1,0.25,0.5,1.0",
+                   help="sample-mode sampling rates (comma list)")
     p.add_argument("--sweep-threads", default="1,2,4,8",
                    help="sweep-mode thread counts (comma list)")
     p.add_argument("--sweep-chunks", default="1,4,16",
@@ -167,6 +170,19 @@ def main(argv: list[str] | None = None) -> int:
         mrc.write_mrc(args.out, curve)
         out.write(f"wrote {len(mrc.dedup_lines(curve))} MRC lines to "
                   f"{args.out} (curve over {len(curve)} cache sizes)\n")
+    elif args.mode == "sample":
+        # the reference's dormant true-sampling surface, live: estimate the
+        # MRC from a fraction of windows and report the error budget
+        # (--window is the K-chunk span knob, pluss/sampling.py)
+        from pluss import sampling
+
+        rates = [float(x) for x in args.rates.split(",") if x]
+        tbl = sampling.mrc_error_table(spec, cfg, rates, share_cap=args.share_cap,
+                                       window_accesses=args.window)
+        out.write(f"{spec.name}: sampled-MRC L2 error vs full enumeration\n")
+        out.write("rate,walked_fraction,l2_error\n")
+        for rate, frac, err in tbl:
+            out.write(f"{rate:g},{frac:.6g},{err:.6g}\n")
     elif args.mode == "sweep":
         # the tool's raison d'etre: predicted MRCs across parallel schedules
         # (the reference rebuilds per -DTHREAD_NUM/-DCHUNK_SIZE combination)

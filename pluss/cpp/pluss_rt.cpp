@@ -14,13 +14,25 @@ namespace {
 Node parse_node(const long long* t, long long n, long long& i);
 
 Loop parse_loop(const long long* t, long long n, long long& i) {
-  if (i + 5 > n || t[i] != 0) throw std::runtime_error("spec: expected LOOP");
+  if (i + 5 > n || (t[i] != 0 && t[i] != 2))
+    throw std::runtime_error("spec: expected LOOP");
   Loop lp;
+  bool tri = t[i] == 2;  // triangular: token carries the (a, b) bound
   lp.trip = t[i + 1];
   lp.start = t[i + 2];
   lp.step = t[i + 3];
-  long long n_body = t[i + 4];
-  i += 5;
+  long long n_body;
+  if (tri) {
+    if (i + 7 > n) throw std::runtime_error("spec: truncated TRI LOOP");
+    lp.bounded = true;
+    lp.bound_a = t[i + 4];
+    lp.bound_b = t[i + 5];
+    n_body = t[i + 6];
+    i += 7;
+  } else {
+    n_body = t[i + 4];
+    i += 5;
+  }
   for (long long b = 0; b < n_body; ++b) lp.body.push_back(parse_node(t, n, i));
   return lp;
 }
@@ -28,7 +40,7 @@ Loop parse_loop(const long long* t, long long n, long long& i) {
 Node parse_node(const long long* t, long long n, long long& i) {
   Node node;
   if (i >= n) throw std::runtime_error("spec: truncated");
-  if (t[i] == 0) {
+  if (t[i] == 0 || t[i] == 2) {
     node.loop = std::make_shared<Loop>(parse_loop(t, n, i));
   } else if (t[i] == 1) {
     if (i + 5 > n) throw std::runtime_error("spec: truncated REF");
@@ -76,7 +88,8 @@ struct ThreadState {
   const Config* cfg;
 };
 
-void walk(const Node& node, std::vector<long long>& iv, ThreadState& st) {
+void walk(const Node& node, std::vector<long long>& iv, ThreadState& st,
+          long long k0) {
   if (node.is_ref) {
     const Ref& r = node.ref;
     long long addr = r.addr_base;
@@ -101,10 +114,12 @@ void walk(const Node& node, std::vector<long long>& iv, ThreadState& st) {
     return;
   }
   const Loop& lp = *node.loop;
+  // triangular inner loops run a + b*k0 iterations at parallel index k0
+  long long trip = lp.bounded ? lp.bound_a + lp.bound_b * k0 : lp.trip;
   iv.push_back(0);
-  for (long long k = 0; k < lp.trip; ++k) {
+  for (long long k = 0; k < trip; ++k) {
     iv.back() = lp.start + k * lp.step;
-    for (const Node& b : lp.body) walk(b, iv, st);
+    for (const Node& b : lp.body) walk(b, iv, st, k0);
   }
   iv.pop_back();
 }
@@ -123,7 +138,7 @@ void run_thread(const Spec& spec, const Config& cfg, int tid, ThreadState& st) {
       iv.push_back(0);
       for (long long k = b; k < e; ++k) {
         iv[0] = nest.start + k * nest.step;
-        for (const Node& body : nest.body) walk(body, iv, st);
+        for (const Node& body : nest.body) walk(body, iv, st, k);
       }
     }
   }

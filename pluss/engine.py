@@ -52,7 +52,13 @@ from pluss.ops.reuse import (
     window_events,
 )
 from pluss.sched import ChunkSchedule
-from pluss.spec import FlatRef, LoopNestSpec, flatten_nest, nest_iteration_size
+from pluss.spec import (
+    FlatRef,
+    LoopNestSpec,
+    flatten_nest,
+    nest_iteration_size,
+    nest_iteration_size_affine,
+)
 
 #: default accesses per scan window (per simulated thread); streams shorter
 #: than this compile to a single window with no scan overhead.
@@ -121,6 +127,11 @@ class NestPlan:
     #: every window, alongside the template (which covers the other refs).
     #: Equal to ``refs`` when no template exists.
     var_refs: tuple[FlatRef, ...] = ()
+    #: triangular nests only: [T, NW*W*CS] exclusive running access count at
+    #: each stream slot (the thread's clock when the slot's parallel
+    #: iteration starts); None for rectangular nests, whose positions are
+    #: closed-form rank * body
+    clock: np.ndarray | None = None
 
     def ultra_windows(self) -> np.ndarray:
         """[NW] bool: windows on the static-template path (clean for EVERY
@@ -428,14 +439,33 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
 
     nests: list[NestPlan] = []
     iters = np.zeros((len(spec.nests), T), np.int64)
+    acc = np.zeros((len(spec.nests), T), np.int64)  # true accesses per thread
     for ni, (sched, refs, body, asg, owned, W, NW) in enumerate(geom):
+        n0, n1 = nest_iteration_size_affine(spec.nests[ni])
         tpl = clean = None
         var_refs = refs
+        clock = None
+        if n1 != 0:
+            # triangular nest: per-iteration body size is affine in the
+            # parallel index, so stream positions need a per-thread clock
+            # table — the exclusive running access count at every (round,
+            # chunk-slot) of the thread's stream (invalid slots add 0)
+            CS = cfg.chunk_size
+            g = owned[:, :, None].astype(np.int64) * CS + np.arange(CS)
+            valid = (owned[:, :, None] >= 0) & (g < sched.trip)
+            body_slot = np.where(valid, n0 + n1 * g, 0).reshape(T, -1)
+            clock = np.concatenate(
+                [np.zeros((T, 1), np.int64), np.cumsum(body_slot, axis=1)],
+                axis=1,
+            )[:, :-1]
+            acc[ni] = body_slot.sum(axis=1)
         # custom chunk->thread maps break the linear cid progression the
-        # shift-invariance argument rests on; the sort path handles them.
-        # Oversize windows would make the host-side template analysis itself
-        # the bottleneck — skip it and let the device sort.
-        if asg is None and W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW:
+        # shift-invariance argument rests on; triangular nests break shift
+        # invariance outright; the sort path handles both.  Oversize windows
+        # would make the host-side template analysis itself the bottleneck —
+        # skip it and let the device sort.
+        if asg is None and n1 == 0 and \
+                W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW:
             tpl_refs, split_var = _split_ref_groups(refs, sched, cfg)
             if tpl_refs:
                 clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
@@ -446,16 +476,17 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                 if tpl is not None:
                     var_refs = split_var
         nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean,
-                              var_refs))
+                              var_refs, clock))
         for t in range(T):
             for cid in owned[t]:
                 if cid >= 0:
                     b, e = sched.chunk_index_range(int(cid))
                     iters[ni, t] += e - b
-    body_sizes = np.array([n.body for n in nests], np.int64)
-    nest_base = np.zeros_like(iters)
-    nest_base[1:] = np.cumsum(iters[:-1] * body_sizes[:-1, None], axis=0)
-    total = int((iters * body_sizes[:, None]).sum())
+        if n1 == 0:
+            acc[ni] = iters[ni] * body
+    nest_base = np.zeros_like(acc)
+    nest_base[1:] = np.cumsum(acc[:-1], axis=0)
+    total = int(acc.sum())
     return StreamPlan(
         spec=spec,
         cfg=cfg,
@@ -468,8 +499,13 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
 
 
 def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
-                owned_row, r0, nest_base, line_base: int, pos_dtype):
-    """(line, pos, span, valid) flat arrays for one ref over rounds [r0, r0+W)."""
+                owned_row, r0, nest_base, line_base: int, pos_dtype,
+                clock_row=None):
+    """(line, pos, span, valid) flat arrays for one ref over rounds [r0, r0+W).
+
+    ``clock_row``: triangular nests only — the thread's [NW*W*CS] stream-slot
+    clock table (NestPlan.clock row).  Rectangular nests use the closed-form
+    ``rank * body`` instead (no gather at all)."""
     CS = cfg.chunk_size
     sched = np_.sched
     shape = (np_.window_rounds, CS) + fr.trips[1:]
@@ -481,13 +517,34 @@ def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
     cid = owned_row[r0 + r]
     g = cid * CS + p
     valid = (cid >= 0) & (g < sched.trip)
-    rank = (r0 + r).astype(pos_dtype) * CS + p
 
-    pos = nest_base + rank * fr.pos_strides[0] + fr.offset
+    if clock_row is None:
+        rank = (r0 + r).astype(pos_dtype) * CS + p
+        pos = nest_base + rank * fr.pos_strides[0] + fr.offset
+    else:
+        # triangular: the iteration's start clock comes from the table (a
+        # [W, CS] gather, tiny next to the window), and the in-iteration
+        # offset/strides pick up their affine-in-k slope terms
+        W = np_.window_rounds
+        slot2 = (r0 + jnp.arange(W, dtype=jnp.int32))[:, None] * CS \
+            + jnp.arange(CS, dtype=jnp.int32)[None, :]
+        start_clock = clock_row[slot2].reshape(
+            (W, CS) + (1,) * len(fr.trips[1:])
+        ).astype(pos_dtype)
+        gk = g.astype(pos_dtype)
+        pos = nest_base + start_clock + fr.offset + fr.offset_k * gk
     addr = fr.ref.addr_base + fr.addr_coefs[0] * (sched.start + g * sched.step)
     for l in range(1, len(fr.trips)):
         idx = iota(l + 1)
-        pos = pos + idx.astype(pos_dtype) * fr.pos_strides[l]
+        if clock_row is None or fr.pos_strides_k[l] == 0:
+            pos = pos + idx.astype(pos_dtype) * fr.pos_strides[l]
+        else:
+            pos = pos + idx.astype(pos_dtype) * (
+                fr.pos_strides[l] + fr.pos_strides_k[l] * gk
+            )
+        if fr.bounds and fr.bounds[l] is not None:
+            a, b = fr.bounds[l]
+            valid = valid & (idx < a + b * g)
         if fr.addr_coefs[l]:
             addr = addr + fr.addr_coefs[l] * (fr.starts[l] + idx * fr.steps[l])
     line = line_base + addr * cfg.ds // cfg.cls
@@ -501,14 +558,14 @@ def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
 
 
 def _window_parts(np_: NestPlan, refs, cfg, owned_row, r0, nest_base, bases,
-                  array_index, pdt) -> list:
+                  array_index, pdt, clock_row=None) -> list:
     """Per-ref (line, pos, span, valid) blocks of one nest window — the
     enumeration step of :func:`_sort_window` (which appends ghost blocks;
     both the single-device scan and the sharded backend's sub-window scan
     go through it)."""
     return [
         _ref_window(fr, np_, cfg, owned_row, r0, nest_base,
-                    bases[array_index(fr.ref.array)], pdt)
+                    bases[array_index(fr.ref.array)], pdt, clock_row)
         for fr in refs
     ]
 
@@ -532,7 +589,7 @@ def _array_ranges(refs, spec, cfg) -> tuple[tuple[int, int], ...]:
 
 def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
                  array_index, pdt, last_pos, win_shift: int,
-                 with_hist: bool = True):
+                 with_hist: bool = True, clock_row=None):
     """One sort-path window over ``refs``, ghost-merged with the carry.
 
     The carried ``last_pos`` slices of the covered arrays enter the sort as
@@ -551,10 +608,15 @@ def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
     """
     r0 = w * np_.window_rounds
     parts = _window_parts(np_, refs, cfg, owned_row, r0, nb, bases,
-                          array_index, pdt)
+                          array_index, pdt, clock_row)
     parts += [ghost_entries(last_pos[b:b + c], b, pdt) for b, c in ranges]
     key_s, pos_s, span_s, valid_s = _sorted_parts(parts)
-    win_start = nb + w.astype(pdt) * win_shift
+    if clock_row is None:
+        win_start = nb + w.astype(pdt) * win_shift
+    else:
+        # triangular: the window's smallest possible position is the clock
+        # at its first stream slot
+        win_start = nb + clock_row[r0 * cfg.chunk_size].astype(pdt)
     ev = carried_events(key_s, pos_s, span_s, valid_s, win_start)
     hist_delta = event_histogram(ev) if with_hist else None
     tails = extract_tails(key_s, pos_s, valid_s, sum(c for _, c in ranges))
@@ -583,13 +645,16 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
         win_shift = np_.window_rounds * cfg.chunk_size * np_.body
         all_ranges = _array_ranges(np_.refs, pl.spec, cfg)
         var_ranges = _array_ranges(np_.var_refs, pl.spec, cfg)
+        clock_row = None if np_.clock is None else jnp.asarray(np_.clock)[tid]
 
         def sort_step(carry, w, np_=np_, owned_row=owned_row, nb=nb,
-                      win_shift=win_shift, all_ranges=all_ranges):
+                      win_shift=win_shift, all_ranges=all_ranges,
+                      clock_row=clock_row):
             last_pos, hist = carry
             last_pos, dh, ev, _ = _sort_window(
                 np_, np_.refs, all_ranges, cfg, owned_row, w, nb, bases,
                 pl.spec.array_index, pdt, last_pos, win_shift,
+                clock_row=clock_row,
             )
             sv, sc, snu = share_unique(ev, share_cap)
             return (last_pos, hist + dh), (sv, sc, snu)
@@ -782,6 +847,9 @@ class SamplerResult:
     share_raw: list[dict]       # [T] {raw reuse: count}
     share_ratio: int
     max_iteration_count: int
+    #: fraction of the stream actually walked — 1.0 for full enumeration;
+    #: < 1 only for pluss.sampling estimates (float counts, scaled)
+    sampled_fraction: float = 1.0
 
     @property
     def thread_num(self) -> int:

@@ -12,9 +12,14 @@ Share spans follow the generated formula ``(trip+1)*trip+1`` of the j loop
 involve the parallel iterator — those are the reuses that cross simulated
 threads, as B0 does in GEMM (``gemm_sampler.rs:196-201``).
 
-``syrk`` uses the rectangular (full-matrix) PolyBench 3.x form so all loops stay
-rectangular; PolyBench 4.2's triangular j<=i variant is out of scope for the
-affine engine and noted here for the record.
+``syrk`` uses the rectangular (full-matrix) PolyBench 3.x form so all loops
+stay rectangular.  PolyBench 4.2's triangular ``j <= i`` variant needs
+value-dependent inner bounds (quadratic clock offsets); the engine's
+triangular support lives behind ``Loop.bound_coef`` — see
+:func:`syrk_triangular` below and ``tests/test_triangular.py``.  The
+reference itself has no triangular sampler (its one workload is rectangular
+GEMM, ``/root/reference/c_lib/test/gemm.ppcg_omp.c:90-96``), so this is
+capability-surface extension, not parity.
 """
 
 from __future__ import annotations
@@ -95,4 +100,33 @@ def syrk(n: int = 128) -> LoopNestSpec:
         name=f"syrk{n}",
         arrays=(("C", n * n), ("A", n * n)),
         nests=(nest,),
+    )
+
+
+def syrk_triangular(n: int = 128) -> LoopNestSpec:
+    """syrk, PolyBench 4.2 triangular form: only ``j <= i`` is touched.
+
+    Mirrors the 4.2 kernel statement-for-statement: per parallel iteration
+    ``i``, a bounded j-loop scales ``C[i][j]``, then the k-loop re-walks the
+    bounded j-loop accumulating ``alpha*A[i][k]*A[j][k]``.  Both j-loops
+    carry ``bound_coef=(1, 1)`` (trip ``i+1`` at parallel index ``i``); the
+    cross-thread reference is ``A1 = A[j][k]`` as in the rectangular form.
+    """
+    span = share_span_formula(n)
+    c01 = Loop(trip=n, bound_coef=(1, 1), body=(
+        Ref("C0", "C", addr_terms=((0, n), (1, 1))),
+        Ref("C1", "C", addr_terms=((0, n), (1, 1))),
+    ))
+    accum = Loop(trip=n, body=(
+        Loop(trip=n, bound_coef=(1, 1), body=(
+            Ref("A0", "A", addr_terms=((0, n), (1, 1))),
+            Ref("A1", "A", addr_terms=((2, n), (1, 1)), share_span=span),
+            Ref("C2", "C", addr_terms=((0, n), (2, 1))),
+            Ref("C3", "C", addr_terms=((0, n), (2, 1))),
+        )),
+    ))
+    return LoopNestSpec(
+        name=f"syrk_tri{n}",
+        arrays=(("C", n * n), ("A", n * n)),
+        nests=(Loop(trip=n, body=(c01, accum)),),
     )

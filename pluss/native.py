@@ -110,6 +110,14 @@ def spec_tokens(spec: LoopNestSpec) -> np.ndarray:
             ])
             for depth, coef in item.addr_terms:
                 toks.extend([depth, coef])
+        elif item.bound_coef is not None:
+            # triangular loop: token type 2 carries the (a, b) bound
+            # (effective trip a + b*k at parallel index k)
+            toks.extend([2, item.trip, item.start, item.step,
+                         item.bound_coef[0], item.bound_coef[1],
+                         len(item.body)])
+            for b in item.body:
+                emit(b)
         else:
             toks.extend([0, item.trip, item.start, item.step, len(item.body)])
             for b in item.body:

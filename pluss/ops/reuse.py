@@ -239,15 +239,18 @@ def bin_histogram(bins: jnp.ndarray, wgt: jnp.ndarray,
     return out[0].astype(wgt.dtype)
 
 
-def event_histogram(ev: dict) -> jnp.ndarray:
+def event_histogram(ev: dict, include_cold: bool = True) -> jnp.ndarray:
     """[NBINS] dense histogram of one window: slot 0 = cold (-1), slot 1+e = 2^e.
 
     No-share reuses are binned at insert (utils.rs:106-107, SURVEY.md Q6);
     share reuses are excluded (they stay raw until the racetrack post-pass).
+    ``include_cold=False`` drops the cold weight — the sharded backend's
+    device-local "cold" entries are unresolved heads, settled only after the
+    cross-device tail exchange.
     """
     evt = ev["is_evt"] & ~ev["share"]
     bins = jnp.where(evt, log2_bin(ev["reuse"]), 0)
-    w = (ev["cold"] | evt).astype(ev["reuse"].dtype)
+    w = ((ev["cold"] | evt) if include_cold else evt).astype(ev["reuse"].dtype)
     return bin_histogram(bins, w)
 
 

@@ -45,6 +45,7 @@ from pluss.engine import (
 )
 from pluss.ops.reuse import (
     bin_histogram,
+    event_histogram,
     log2_bin,
     share_mask,
     share_unique,
@@ -107,18 +108,6 @@ def _vary(tree):
     return jax.tree.map(_vary_leaf, tree)
 
 
-def _hist_no_cold(ev: dict, pdt) -> jnp.ndarray:
-    """[NBINS] histogram of one window's resolved no-share events ONLY.
-
-    Unlike :func:`pluss.ops.reuse.event_histogram`, device-local "cold"
-    entries are excluded: on a shard they are unresolved heads, settled
-    after the cross-device tail exchange (cold only if NO earlier device
-    touched the line)."""
-    evt = ev["is_evt"] & ~ev["share"]
-    bins = jnp.where(evt, log2_bin(ev["reuse"]), 0)
-    return bin_histogram(bins, evt.astype(pdt))
-
-
 def _capture_heads(head_pos, head_span, cold, key_s, pos_s, span_s,
                    n_lines: int):
     """Record first-in-device touches from one sorted sub-window.
@@ -170,15 +159,16 @@ def _nest_results(np_, ni: int, tids, pl: StreamPlan, share_cap: int, d,
     def one(t):
         owned_row = jnp.asarray(np_.owned)[t]
         nb = nest_base[ni, t]
+        clock_row = None if np_.clock is None else jnp.asarray(np_.clock)[t]
 
         def sort_body(carry, w):
             last_pos, hist, head_pos, head_span = carry
             last_pos, _, ev, (key_s, pos_s, span_s) = _sort_window(
                 np_, np_.refs, all_ranges, cfg, owned_row, w, nb, bases,
                 pl.spec.array_index, pdt, last_pos, win_shift,
-                with_hist=False,
+                with_hist=False, clock_row=clock_row,
             )
-            hist = hist + _hist_no_cold(ev, pdt)
+            hist = hist + event_histogram(ev, include_cold=False)
             head_pos, head_span = _capture_heads(
                 head_pos, head_span, ev["cold"], key_s, pos_s, span_s,
                 n_lines,
@@ -198,7 +188,7 @@ def _nest_results(np_, ni: int, tids, pl: StreamPlan, share_cap: int, d,
                     bases, pl.spec.array_index, pdt, last_pos, win_shift,
                     with_hist=False,
                 )
-                hist = hist + _hist_no_cold(ev_var, pdt)
+                hist = hist + event_histogram(ev_var, include_cold=False)
                 head_pos, head_span = _capture_heads(
                     head_pos, head_span, ev_var["cold"], vk, vp, vs, n_lines)
             hp, hs, tp = _tpl_dense(np_.tpl, t, w, n_lines, pl.pos_dtype, nb)
@@ -287,7 +277,16 @@ def _shard_body(tids, pl: StreamPlan, share_cap: int, D: int, S: int):
     total = hist.sum(axis=1) + head_hist            # [T, NBINS]
     total = jax.lax.psum(total, "d")                # replicated merge over ICI
     head_share = jnp.where(share, reuse, -1)        # [T, N, L] raw values
-    return total, sv[None], sc[None], snu[None], head_share[None]
+    # replicate the per-device outputs (all small): in multi-PROCESS runs a
+    # host can only read addressable shards, so device-sharded outputs would
+    # not be fetchable — all_gather makes every output host-readable on
+    # every process (the DCN story stays collectives-only).  The pmax over
+    # identical gathered copies is an identity that PROVES replication to
+    # shard_map's vma check, keeping out_specs=P() statically valid.
+    return (total,) + tuple(
+        jax.lax.pmax(jax.lax.all_gather(x, "d"), "d")
+        for x in (sv, sc, snu, head_share)
+    )
 
 
 @functools.lru_cache(maxsize=32)
@@ -305,7 +304,7 @@ def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
         lambda t: _shard_body(t, pl, share_cap, D, S),
         mesh=mesh,
         in_specs=P(),
-        out_specs=(P(), P("d"), P("d"), P("d"), P("d")),
+        out_specs=P(),
     )
     return pl, jax.jit(f)
 

@@ -1,0 +1,171 @@
+"""True subset sampling: estimate the histograms from a fraction of windows.
+
+The reference DECLARES this capability but never wires it: ``Iteration`` /
+``IterationComp`` order sampled points (``/root/reference/src/iteration.rs:
+1-213``), and the C++ dispatcher's ``setStartPoint`` / ``getStaticStartChunk``
+/ ``getNextKChunksFrom`` APIs (``c_lib/test/runtime/pluss_utils.h:443-587``)
+exist so a sampler can start mid-loop and walk K chunks of context from a
+sampled start point.  No reference ``main`` ever calls them — the live
+samplers enumerate everything ("sampler without sampling",
+``src/gemm_sampler.rs:55``).  This module completes the declared surface.
+
+Design (TPU-native): the sample unit is the engine's round-window — a
+``setStartPoint`` at the window's first iteration plus ``getNextKChunksFrom``
+context, as one fixed-shape unit.  A host RNG picks ``rate * NW`` windows per
+nest; every sampled window is walked EXACTLY (the same ghost-merged sort as
+the full engine) from an empty LAT table, in parallel — samples are
+independent, so the whole estimate is one ``vmap`` over (thread, window) with
+no carry, the embarrassingly-parallel shape the full scan cannot have.
+
+Semantics of a sampled window match a reference run restricted to it: reuses
+inside the window are exact; accesses whose predecessor lies OUTSIDE the
+window are censored and counted as cold, exactly like the reference's
+end-of-run flush (``gemm_sampler.rs:48-53``) at the window boundary.
+Histogram counts scale by ``NW / n_sampled``.  The bias (boundary cold
+instead of long carried reuses) shrinks as the window span grows —
+``window_accesses`` IS the K-chunk span knob.  At ``NW == 1`` the estimate
+degenerates to the exact full enumeration.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
+from pluss.engine import (
+    SamplerResult,
+    _array_ranges,
+    _sort_window,
+    merge_share_windows,
+    plan,
+)
+from pluss.ops.reuse import share_unique
+from pluss.spec import LoopNestSpec
+
+
+@functools.lru_cache(maxsize=64)
+def _plan_cached(spec: LoopNestSpec, cfg: SamplerConfig,
+                 window_accesses: int | None):
+    """One plan per (spec, cfg, span) — shared by every nest's window fn."""
+    return plan(spec, cfg, window_accesses=window_accesses)
+
+
+@functools.lru_cache(maxsize=64)
+def _window_fn(spec: LoopNestSpec, cfg: SamplerConfig, ni: int,
+               share_cap: int, window_accesses: int | None):
+    """jit[(T,), (nsel,)] -> per-(thread, window) fresh-carry walk results."""
+    pl = _plan_cached(spec, cfg, window_accesses)
+    np_ = pl.nests[ni]
+    bases = pl.spec.line_bases(cfg)
+    n_lines = pl.spec.total_lines(cfg)
+    pdt = jnp.dtype(pl.pos_dtype)
+    nest_base = jnp.asarray(pl.nest_base.astype(pl.pos_dtype))
+    win_shift = np_.window_rounds * cfg.chunk_size * np_.body
+    ranges = _array_ranges(np_.refs, pl.spec, cfg)
+
+    def one(t, w):
+        last_pos = jnp.full((n_lines,), -1, pdt)
+        clock_row = None if np_.clock is None else jnp.asarray(np_.clock)[t]
+        _, dh, ev, _ = _sort_window(
+            np_, np_.refs, ranges, cfg, jnp.asarray(np_.owned)[t], w,
+            nest_base[ni, t], bases, pl.spec.array_index, pdt, last_pos,
+            win_shift, clock_row=clock_row,
+        )
+        sv, sc, snu = share_unique(ev, share_cap)
+        return dh, sv, sc, snu
+
+    fn = jax.jit(jax.vmap(jax.vmap(one, in_axes=(None, 0)),
+                          in_axes=(0, None)))
+    return pl, fn
+
+
+def sampled_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
+                rate: float = 0.1, seed: int = 0,
+                share_cap: int = SHARE_CAP,
+                window_accesses: int | None = None) -> SamplerResult:
+    """Estimate the per-thread histograms from a ``rate`` fraction of windows.
+
+    Returns a :class:`SamplerResult` with FLOAT counts (scaled estimates);
+    ``max_iteration_count`` reports the true full-stream access count the
+    estimate stands for, and ``sampled_fraction`` the fraction of that
+    stream actually walked — ``nsel/NW`` rounding means it can exceed the
+    requested rate substantially at small window counts.
+    ``window_accesses`` sets the sample span (the K-chunk context of the
+    reference's ``getNextKChunksFrom``).
+    """
+    if not 0.0 < rate <= 1.0:
+        raise ValueError(f"sampling rate must be in (0, 1], got {rate}")
+    T = cfg.thread_num
+    rng = np.random.default_rng(seed)
+    hist = np.zeros((T, NBINS), np.float64)
+    share_raw: list[dict] = [dict() for _ in range(T)]
+    pl = None
+    walked = 0.0
+    for ni in range(len(spec.nests)):
+        pl, fn = _window_fn(spec, cfg, ni, share_cap, window_accesses)
+        NW = pl.nests[ni].n_windows
+        nsel = max(1, round(rate * NW))
+        sel = np.sort(rng.choice(NW, nsel, replace=False)).astype(np.int32)
+        scale = NW / nsel
+        dh, sv, sc, snu = fn(jnp.arange(T, dtype=jnp.int32),
+                             jnp.asarray(sel))
+        dh = np.asarray(dh)
+        hist += dh.sum(axis=1) * scale
+        part = merge_share_windows([np.asarray(sv)], [np.asarray(sc)],
+                                   [np.asarray(snu)], share_cap, T)
+        # every walked access lands in exactly one bucket (event, cold, or
+        # share), so the unscaled masses measure the TRUE walked fraction
+        walked += float(dh.sum())
+        for t in range(T):
+            for v, c in part[t].items():
+                share_raw[t][v] = share_raw[t].get(v, 0.0) + c * scale
+                walked += c
+    return SamplerResult(
+        noshare_dense=hist,
+        share_raw=share_raw,
+        share_ratio=T - 1,
+        max_iteration_count=pl.total_count,
+        sampled_fraction=walked / pl.total_count if pl.total_count else 0.0,
+    )
+
+
+def mrc_l2_error(a: np.ndarray, b: np.ndarray) -> float:
+    """Relative L2 error between two MRC curves (padded to equal length)."""
+    n = max(len(a), len(b))
+    pa = np.pad(np.asarray(a, np.float64), (0, n - len(a)), mode="edge")
+    pb = np.pad(np.asarray(b, np.float64), (0, n - len(b)), mode="edge")
+    denom = float(np.linalg.norm(pb))
+    return float(np.linalg.norm(pa - pb)) / denom if denom else 0.0
+
+
+def mrc_error_table(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
+                    rates=(0.05, 0.1, 0.25, 0.5, 1.0), seed: int = 0,
+                    share_cap: int = SHARE_CAP,
+                    window_accesses: int | None = None):
+    """[(rate, sampled_fraction_of_accesses, mrc_l2_error)] vs full run.
+
+    The payoff table the reference's dormant sampling surface was built
+    for: how much of the stream must be walked for how much MRC accuracy.
+    """
+    from pluss import cri, engine, mrc
+
+    full = engine.run(spec, cfg, share_cap)
+    full_curve = mrc.aet_mrc(
+        cri.distribute(full.noshare_list(), full.share_list(), cfg.thread_num),
+        cfg,
+    )
+    out = []
+    for rate in rates:
+        est = sampled_run(spec, cfg, rate, seed, share_cap, window_accesses)
+        est_curve = mrc.aet_mrc(
+            cri.distribute(est.noshare_list(), est.share_list(),
+                           cfg.thread_num),
+            cfg,
+        )
+        out.append((rate, est.sampled_fraction,
+                    mrc_l2_error(est_curve, full_curve)))
+    return out

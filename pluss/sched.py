@@ -128,6 +128,28 @@ class ChunkSchedule:
         first = start_round * self.thread_num + tid
         return [c for c in range(first, self.n_chunks, self.thread_num) if c >= 0]
 
+    def static_start_chunk(self, i: int, tid: int) -> tuple[int, int]:
+        """Value-space start chunk of ``tid`` after ``setStartPoint(i)`` —
+        the reference's ``getStaticStartChunk`` (pluss_utils.h:474-490).
+
+        Pins two quirks of the original: the resume point's INTRA-chunk
+        offset applies to EVERY thread's start chunk (not only the owner of
+        ``i`` — the per-tid rounding edge), and only the far bound is
+        clamped to the loop's last value, so a thread whose shifted start
+        lies beyond the end returns an inverted (empty) range, exactly as
+        the reference does.
+        """
+        pos = self.static_thread_local_pos(i)
+        base = (self.start
+                + self.chunk_size * self.step * tid
+                + self.static_chunk_id(i)
+                * self.chunk_size * self.thread_num * self.step)
+        near = base + pos * self.step
+        far = base + (self.chunk_size - 1) * self.step
+        if self.step > 0:
+            return near, min(far, self.last)
+        return max(far, self.last), near
+
     def start_chunk_of(self, i: int) -> int:
         """Global chunk id containing iteration value ``i`` (``getStartChunk``
         rounding, pluss_utils.h:492-516)."""

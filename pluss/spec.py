@@ -60,16 +60,28 @@ class Ref:
 
 @dataclasses.dataclass(frozen=True)
 class Loop:
-    """A rectangular loop: ``for iv in (start, start+step, ...) x trip: body``.
+    """A loop: ``for iv in (start, start+step, ...) x trip: body``.
 
     ``body`` is an ordered tuple of :class:`Ref` and nested :class:`Loop` items,
     executed in order each iteration.
+
+    ``bound_coef``: optional ``(a, b)`` making this an inner TRIANGULAR loop:
+    its effective trip at parallel index ``k`` (0-based index of the nest's
+    outermost loop) is ``a + b*k``, e.g. PolyBench 4.2 syrk's ``j <= i`` is
+    ``(1, 1)``.  ``trip`` must be the static maximum (``a + b*(ptrip-1)``).
+    Restrictions (validated by :func:`flatten_nest`): only inner loops may be
+    bounded, bounds depend on the parallel index alone, and bounded loops
+    must not nest inside each other — that keeps every stream position
+    AFFINE in ``k``, which is what lets the engine enumerate triangular
+    nests with the same iota arithmetic as rectangular ones (plus one
+    per-thread clock table for the varying per-iteration body size).
     """
 
     trip: int
     body: tuple[Union["Loop", Ref], ...]
     start: int = 0
     step: int = 1
+    bound_coef: tuple[int, int] | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,10 +126,33 @@ class LoopNestSpec:
 
 
 def loop_size(item: Union[Loop, Ref]) -> int:
-    """Total accesses performed by one execution of ``item``."""
+    """Total accesses performed by one execution of ``item`` (static max for
+    bounded loops — their ``trip`` is the declared maximum)."""
     if isinstance(item, Ref):
         return 1
     return item.trip * sum(loop_size(b) for b in item.body)
+
+
+def loop_size_affine(item: Union[Loop, Ref]) -> tuple[int, int]:
+    """Accesses of one execution of ``item`` as ``c0 + c1*k`` (``k`` = the
+    parallel index).  Rejects a bounded loop containing another bounded
+    loop — that product would be quadratic in ``k``, outside the affine
+    contract the engine's iota enumeration relies on."""
+    if isinstance(item, Ref):
+        return (1, 0)
+    b0 = b1 = 0
+    for b in item.body:
+        c0, c1 = loop_size_affine(b)
+        b0 += c0
+        b1 += c1
+    if item.bound_coef is not None:
+        if b1:
+            raise ValueError(
+                "triangular (bounded) loops must not nest inside each other"
+            )
+        a, b = item.bound_coef
+        return (a * b0, b * b0)
+    return (item.trip * b0, item.trip * b1)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,15 +160,20 @@ class FlatRef:
     """A reference flattened against its enclosing loop chain.
 
     For occurrence with per-level indices ``idx[0..d]`` (index space, not value
-    space) the stream position inside one execution of the nest is::
+    space) at parallel index ``k`` the stream position inside one execution of
+    the nest is::
 
-        pos = offset + sum(idx[l] * pos_stride[l])
+        pos = (offset + offset_k*k) + sum(idx[l] * (pos_stride[l] + pos_stride_k[l]*k))
 
     and the element address is::
 
         addr = addr_base + sum(addr_coef[l] * (start[l] + step[l]*idx[l]))
 
-    ``pos_stride[l]`` is the access count of one iteration of loop ``l``'s body.
+    ``pos_stride[l]`` is the access count of one iteration of loop ``l``'s
+    body; the ``*_k`` terms are its slope in ``k`` (nonzero only when a
+    triangular loop sits below — see :class:`Loop` ``bound_coef``).
+    ``bounds[l]`` is loop ``l``'s ``(a, b)`` bound or None; a bounded
+    level's valid index range is ``idx[l] < a + b*k``.
     """
 
     ref: Ref
@@ -143,21 +183,45 @@ class FlatRef:
     pos_strides: tuple[int, ...]
     offset: int
     addr_coefs: tuple[int, ...]  # dense, one per enclosing loop depth
+    pos_strides_k: tuple[int, ...] = ()
+    offset_k: int = 0
+    bounds: tuple[tuple[int, int] | None, ...] = ()
 
 
 def flatten_nest(nest: Loop) -> list[FlatRef]:
     """Flatten one parallel nest into per-reference affine occurrence specs."""
     out: list[FlatRef] = []
+    if nest.bound_coef is not None:
+        raise ValueError(
+            "the parallel (outermost) loop must be rectangular; bound_coef is "
+            "for inner loops"
+        )
 
-    def walk(loop: Loop, chain: list[Loop], offset: int) -> None:
+    def check_bound(loop: Loop) -> None:
+        a, b = loop.bound_coef
+        ends = (a, a + b * (nest.trip - 1))
+        if min(ends) < 0 or max(ends) > loop.trip:
+            raise ValueError(
+                f"bound {loop.bound_coef} leaves [0, trip={loop.trip}] over "
+                f"parallel indices [0, {nest.trip})"
+            )
+
+    def walk(loop: Loop, chain: list[Loop], off0: int, off1: int) -> None:
         chain = chain + [loop]
-        body_off = 0
+        b_off0 = b_off1 = 0
         for item in loop.body:
             if isinstance(item, Ref):
                 trips = tuple(l.trip for l in chain)
                 starts = tuple(l.start for l in chain)
                 steps = tuple(l.step for l in chain)
-                strides = tuple(sum(loop_size(b) for b in l.body) for l in chain)
+                s_aff = []
+                for l in chain:
+                    s0 = s1 = 0
+                    for b in l.body:
+                        c0, c1 = loop_size_affine(b)
+                        s0 += c0
+                        s1 += c1
+                    s_aff.append((s0, s1))
                 coefs = [0] * len(chain)
                 for depth, coef in item.addr_terms:
                     if depth >= len(chain):
@@ -172,23 +236,46 @@ def flatten_nest(nest: Loop) -> list[FlatRef]:
                         trips=trips,
                         starts=starts,
                         steps=steps,
-                        pos_strides=strides,
-                        offset=offset + body_off,
+                        pos_strides=tuple(s[0] for s in s_aff),
+                        offset=off0 + b_off0,
                         addr_coefs=tuple(coefs),
+                        pos_strides_k=tuple(s[1] for s in s_aff),
+                        offset_k=off1 + b_off1,
+                        bounds=tuple(l.bound_coef for l in chain),
                     )
                 )
-                body_off += 1
+                b_off0 += 1
             else:
-                walk(item, chain, offset + body_off)
-                body_off += loop_size(item)
+                if item.bound_coef is not None:
+                    check_bound(item)
+                walk(item, chain, off0 + b_off0, off1 + b_off1)
+                s0, s1 = loop_size_affine(item)
+                b_off0 += s0
+                b_off1 += s1
 
-    walk(nest, [], 0)
+    walk(nest, [], 0, 0)
     return out
 
 
 def nest_iteration_size(nest: Loop) -> int:
-    """Accesses per iteration of the nest's outermost (parallel) loop."""
-    return sum(loop_size(b) for b in nest.body)
+    """MAX accesses per iteration of the nest's outermost (parallel) loop
+    (for bounded nests: the affine size evaluated at its worst parallel
+    index — used for static shapes and window sizing)."""
+    n0, n1 = nest_iteration_size_affine(nest)
+    if n1 == 0:
+        return n0
+    return max(n0, n0 + n1 * (nest.trip - 1))
+
+
+def nest_iteration_size_affine(nest: Loop) -> tuple[int, int]:
+    """Accesses per parallel iteration as ``n0 + n1*k`` (n1 != 0 marks a
+    triangular nest)."""
+    n0 = n1 = 0
+    for b in nest.body:
+        c0, c1 = loop_size_affine(b)
+        n0 += c0
+        n1 += c1
+    return n0, n1
 
 
 def share_span_formula(trip: int, start: int = 0, step: int = 1) -> int:

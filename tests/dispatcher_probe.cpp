@@ -1,0 +1,24 @@
+// Probe harness around the REFERENCE ChunkDispatcher (not a reimplementation):
+// drives setStartPoint + getStaticStartChunk on the actual class from
+// /root/reference/c_lib/test/runtime/pluss_utils.h so the Python
+// ChunkSchedule.static_start_chunk can be diffed against the original
+// per-tid rounding semantics (pluss_utils.h:443-490).
+//
+// usage: dispatcher_probe trip start step i
+// prints one "lb ub" line per tid.
+#include <cstdio>
+#include <cstdlib>
+#include "pluss_utils.h"
+
+int main(int argc, char **argv) {
+    if (argc != 5) return 2;
+    int trip = atoi(argv[1]), start = atoi(argv[2]);
+    int step = atoi(argv[3]), i = atoi(argv[4]);
+    std::ChunkDispatcher d(CHUNK_SIZE, trip, start, step);
+    d.setStartPoint(i);
+    for (int t = 0; t < THREAD_NUM; t++) {
+        std::Chunk c = d.getStaticStartChunk(i, t);
+        printf("%d %d\n", c.first, c.second);
+    }
+    return 0;
+}

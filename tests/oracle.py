@@ -82,7 +82,14 @@ class OracleSampler:
         if isinstance(item, Ref):
             self._access(tid, item, ivs)
         else:
-            for i in range(item.trip):
+            trip = item.trip
+            if item.bound_coef is not None:
+                # triangular inner loop: effective trip = a + b*k with k the
+                # parallel INDEX of this nest iteration (spec.Loop.bound_coef)
+                a, b = item.bound_coef
+                pstart, pstep = self._pnest
+                trip = a + b * ((ivs[0] - pstart) // pstep)
+            for i in range(trip):
                 v = item.start + i * item.step
                 for b in item.body:
                     self._walk_dispatch(tid, b, ivs + [v])
@@ -94,6 +101,7 @@ class OracleSampler:
         the stateless :class:`ChunkSchedule` API alone."""
         cfg = self.cfg
         for ni, nest in enumerate(self.spec.nests):
+            self._pnest = (nest.start, nest.step)
             sched = ChunkSchedule(
                 cfg.chunk_size, nest.trip, nest.start, nest.step, cfg.thread_num
             )

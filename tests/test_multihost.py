@@ -1,0 +1,86 @@
+"""Multi-process (DCN-model) smoke test: 2 local processes, one shard_run.
+
+The reference has no distributed backend (SURVEY.md §2: shared memory +
+locks); this framework's claim is that multi-host is a *configuration* of the
+collectives-only shard backend.  This test proves the claim for real: two
+OS processes initialize ``jax.distributed`` against a local coordinator,
+form one global 8-device CPU mesh (4 virtual devices each), run
+``shard_run`` in SPMD, and the coordinator's result must equal the
+single-process engine run bit for bit.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = r"""
+import json, sys
+from pluss.utils.platform import force_cpu
+force_cpu(4)  # 4 virtual CPU devices per process -> 8 global
+from pluss.parallel import multihost
+
+port, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                     num_processes=2, process_id=pid)
+import jax
+assert multihost.process_count() == 2
+assert jax.device_count() == 8 and len(jax.local_devices()) == 4
+
+from pluss.config import SamplerConfig
+from pluss.models import gemm
+from pluss.parallel.shard import shard_run
+
+mesh = multihost.global_mesh()
+assert mesh.devices.size == 8
+res = shard_run(gemm(16), SamplerConfig(cls=8), mesh=mesh,
+                window_accesses=1)  # forces S>1 sub-windows across hosts
+if multihost.is_coordinator():
+    json.dump({
+        "count": res.max_iteration_count,
+        "hist": res.noshare_dense.tolist(),
+        "share": [{str(k): v for k, v in d.items()} for d in res.share_raw],
+    }, open(out_path, "w"))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_shard_run_matches_engine(tmp_path):
+    port = socket.socket()
+    port.bind(("127.0.0.1", 0))
+    portno = port.getsockname()[1]
+    port.close()
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out = tmp_path / "res.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "JAX_ENABLE_X64": "1",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(portno), str(i), str(out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{so[-2000:]}\n{se[-2000:]}"
+    got = json.load(open(out))
+
+    from pluss.config import SamplerConfig
+    from pluss.engine import run
+    from pluss.models import gemm
+
+    ref = run(gemm(16), SamplerConfig(cls=8))
+    assert got["count"] == ref.max_iteration_count
+    assert got["hist"] == ref.noshare_dense.tolist()
+    assert got["share"] == [
+        {str(k): v for k, v in d.items()} for d in ref.share_raw
+    ]

@@ -123,3 +123,54 @@ def test_reference_matches_our_native_twin(ref_seq_acc):
               res.rihist(), res.max_iteration_count, buf)
     # acc_block ends with a blank line like the reference's printf("\n")
     assert _body(ref_seq_acc).rstrip("\n") == _body(buf.getvalue()).rstrip("\n")
+
+
+def test_reference_dispatcher_static_start_chunk_per_tid_rounding():
+    """VERDICT r1 gap #3: the per-tid rounding edge of getStaticStartChunk
+    (pluss_utils.h:474-490), diffed against the REFERENCE class itself.
+
+    A probe binary (tests/dispatcher_probe.cpp) drives the reference's own
+    ChunkDispatcher through setStartPoint(i) + getStaticStartChunk(i, t)
+    for every thread; ChunkSchedule.static_start_chunk must reproduce every
+    (lb, ub) pair — including the quirks: the resume point's intra-chunk
+    offset applies to every thread, and only the far bound clamps, so late
+    threads can return inverted (empty) ranges.
+    """
+    from pluss.sched import ChunkSchedule
+
+    cmd = ["g++", *CPPFLAGS, str(HERE / "dispatcher_probe.cpp"), *RUNTIME,
+           "-lm"]
+    tag = hashlib.sha1(" ".join(cmd).encode()).hexdigest()[:10]
+    out = BUILD / f"dispatcher-probe-{tag}"
+    if not out.exists():
+        BUILD.mkdir(exist_ok=True)
+        proc = subprocess.run([*cmd, "-o", str(out)], capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            pytest.fail(f"probe build failed:\n{proc.stderr}")
+
+    cases = [
+        # (trip, start, step): incl. partial last chunk, nonzero start,
+        # stride > 1, and a negative-step loop
+        (16, 0, 1), (23, 0, 1), (16, 5, 1), (20, 0, 2), (30, 2, 3),
+        (16, 15, -1),
+    ]
+    checked = 0
+    for trip, start, step in cases:
+        sched = ChunkSchedule(CHUNK, trip, start, step, THREADS)
+        # resume points across rounds and intra-chunk offsets, incl. the
+        # very last iteration value
+        for k in sorted({0, 1, 3, 5, CHUNK * THREADS, CHUNK * THREADS + 2,
+                         trip // 2, trip - 1}):
+            if not 0 <= k < trip:
+                continue
+            i = start + k * step
+            got = subprocess.run(
+                [str(out), str(trip), str(start), str(step), str(i)],
+                check=True, capture_output=True, text=True).stdout.split()
+            ref = [(int(got[2 * t]), int(got[2 * t + 1]))
+                   for t in range(THREADS)]
+            ours = [sched.static_start_chunk(i, t) for t in range(THREADS)]
+            assert ours == ref, (trip, start, step, i, ours, ref)
+            checked += THREADS
+    assert checked > 100

@@ -8,30 +8,57 @@ from pluss.spec import FlatRef, Loop, Ref, flatten_nest, loop_size, nest_iterati
 
 
 def interpret(nest: Loop):
-    """Walk the tree in program order, yielding (ref, ivs values) per access."""
+    """Walk the tree in program order, yielding (ref, ivs values) per access
+    (honoring triangular bound_coef via the parallel index)."""
     out = []
 
-    def walk(item, ivs):
+    def walk(item, ivs, k0):
         if isinstance(item, Ref):
             out.append((item, tuple(ivs)))
             return
-        for i in range(item.trip):
+        trip = item.trip
+        if item.bound_coef is not None:
+            a, b = item.bound_coef
+            trip = a + b * k0
+        for i in range(trip):
             v = item.start + i * item.step
-            for b in item.body:
-                walk(b, ivs + [v])
+            for b_ in item.body:
+                walk(b_, ivs + [v], i if k0 is None else k0)
 
-    walk(nest, [])
+    walk(nest, [], None)
     return out
 
 
 def flat_positions(nest: Loop):
-    """Evaluate every FlatRef's affine (pos, addr) over its full index grid."""
+    """Evaluate every FlatRef's affine (pos, addr) over its valid index grid.
+
+    Mirrors the engine's position model exactly: the parallel level
+    contributes the running clock (quadratic for triangular nests — the
+    engine's per-thread clock table); inner levels contribute their
+    affine-in-k strides; bounded levels are masked by ``idx < a + b*k``.
+    """
     import itertools
+
+    from pluss.spec import nest_iteration_size_affine
+
+    n0, n1 = nest_iteration_size_affine(nest)
+    clock = [0]
+    for k in range(nest.trip):
+        clock.append(clock[-1] + n0 + n1 * k)
 
     entries = {}
     for fr in flatten_nest(nest):
+        sk = fr.pos_strides_k or (0,) * len(fr.trips)
+        bounds = fr.bounds or (None,) * len(fr.trips)
         for idxs in itertools.product(*(range(t) for t in fr.trips)):
-            pos = fr.offset + sum(i * s for i, s in zip(idxs, fr.pos_strides))
+            k = idxs[0]
+            if any(b is not None and not idxs[l] < b[0] + b[1] * k
+                   for l, b in enumerate(bounds)):
+                continue
+            pos = clock[k] + fr.offset + fr.offset_k * k + sum(
+                i * (s0 + s1 * k)
+                for i, s0, s1 in zip(idxs[1:], fr.pos_strides[1:], sk[1:])
+            )
             ivs = tuple(st + i * sp for st, i, sp in zip(fr.starts, idxs, fr.steps))
             addr = fr.ref.addr_base + sum(c * v for c, v in zip(fr.addr_coefs, ivs))
             entries[pos] = (fr.ref.name, ivs[: len(fr.trips)], addr)
@@ -40,10 +67,13 @@ def flat_positions(nest: Loop):
 
 @pytest.mark.parametrize("name", list(REGISTRY))
 def test_flatten_matches_interpretation(name):
+    from pluss.spec import nest_iteration_size_affine
+
     spec = REGISTRY[name](8 if name != "stencil3d" else 6)
     for nest in spec.nests:
         seq = interpret(nest)
-        assert len(seq) == loop_size(nest)
+        n0, n1 = nest_iteration_size_affine(nest)
+        assert len(seq) == sum(n0 + n1 * k for k in range(nest.trip))
         flat = flat_positions(nest)
         assert len(flat) == len(seq)
         for pos, (ref, ivs) in enumerate(seq):
