@@ -23,12 +23,13 @@ Loop parse_loop(const long long* t, long long n, long long& i) {
   lp.step = t[i + 3];
   long long n_body;
   if (tri) {
-    if (i + 7 > n) throw std::runtime_error("spec: truncated TRI LOOP");
+    if (i + 8 > n) throw std::runtime_error("spec: truncated TRI LOOP");
     lp.bounded = true;
     lp.bound_a = t[i + 4];
     lp.bound_b = t[i + 5];
-    n_body = t[i + 6];
-    i += 7;
+    lp.start_coef = t[i + 6];
+    n_body = t[i + 7];
+    i += 8;
   } else {
     n_body = t[i + 4];
     i += 5;
@@ -114,11 +115,13 @@ void walk(const Node& node, std::vector<long long>& iv, ThreadState& st,
     return;
   }
   const Loop& lp = *node.loop;
-  // triangular inner loops run a + b*k0 iterations at parallel index k0
+  // triangular inner loops run a + b*k0 iterations from value
+  // start + start_coef*k0 at parallel index k0
   long long trip = lp.bounded ? lp.bound_a + lp.bound_b * k0 : lp.trip;
+  long long start = lp.start + lp.start_coef * k0;
   iv.push_back(0);
   for (long long k = 0; k < trip; ++k) {
-    iv.back() = lp.start + k * lp.step;
+    iv.back() = start + k * lp.step;
     for (const Node& b : lp.body) walk(b, iv, st, k0);
   }
   iv.pop_back();

@@ -38,9 +38,9 @@ struct Node;  // LOOP or REF
 struct Loop {
   long long trip = 0, start = 0, step = 1;
   // triangular bound (spec.Loop.bound_coef): effective trip = a + b*k at
-  // parallel index k when `bounded`
+  // parallel index k when `bounded`; first value = start + start_coef*k
   bool bounded = false;
-  long long bound_a = 0, bound_b = 0;
+  long long bound_a = 0, bound_b = 0, start_coef = 0;
   std::vector<Node> body;
 };
 struct Node {

@@ -401,14 +401,18 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
          assignment: tuple[tuple[int, ...] | None, ...] | None = None,
          start_point: int | None = None,
          window_accesses: int | None = None,
-         n_windows: int | None = None) -> StreamPlan:
+         n_windows: int | None = None,
+         build_templates: bool = True) -> StreamPlan:
     """Build the static stream plan.
 
     ``assignment``: optional per-nest chunk->thread maps (dynamic scheduling);
     ``start_point``: resume iteration value applied to the first nest;
     ``window_accesses``: scan-window size override (default WINDOW_TARGET);
     ``n_windows``: force exactly this many equal round windows per nest (the
-    sharded backend maps S sub-windows per device).
+    sharded backend maps S sub-windows per device);
+    ``build_templates``: False skips the host-side static-window template
+    analysis — for callers that only ever take the sort path (the subset
+    sampler's fresh-carry windows).
     """
     T = cfg.thread_num
     geom = []  # (sched, refs, body, asg, owned, W, NW) per nest
@@ -459,12 +463,13 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                 axis=1,
             )[:, :-1]
             acc[ni] = body_slot.sum(axis=1)
+            iters[ni] = valid.sum(axis=(1, 2))
         # custom chunk->thread maps break the linear cid progression the
         # shift-invariance argument rests on; triangular nests break shift
         # invariance outright; the sort path handles both.  Oversize windows
         # would make the host-side template analysis itself the bottleneck —
         # skip it and let the device sort.
-        if asg is None and n1 == 0 and \
+        if build_templates and asg is None and n1 == 0 and \
                 W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW:
             tpl_refs, split_var = _split_ref_groups(refs, sched, cfg)
             if tpl_refs:
@@ -477,12 +482,12 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                     var_refs = split_var
         nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean,
                               var_refs, clock))
-        for t in range(T):
-            for cid in owned[t]:
-                if cid >= 0:
-                    b, e = sched.chunk_index_range(int(cid))
-                    iters[ni, t] += e - b
-        if n1 == 0:
+        if n1 == 0:  # triangular nests already counted via body_slot above
+            for t in range(T):
+                for cid in owned[t]:
+                    if cid >= 0:
+                        b, e = sched.chunk_index_range(int(cid))
+                        iters[ni, t] += e - b
             acc[ni] = iters[ni] * body
     nest_base = np.zeros_like(acc)
     nest_base[1:] = np.cumsum(acc[:-1], axis=0)
@@ -546,7 +551,10 @@ def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
             a, b = fr.bounds[l]
             valid = valid & (idx < a + b * g)
         if fr.addr_coefs[l]:
-            addr = addr + fr.addr_coefs[l] * (fr.starts[l] + idx * fr.steps[l])
+            start_l = fr.starts[l]
+            if fr.starts_k and fr.starts_k[l]:
+                start_l = start_l + fr.starts_k[l] * g  # varying loop start
+            addr = addr + fr.addr_coefs[l] * (start_l + idx * fr.steps[l])
     line = line_base + addr * cfg.ds // cfg.cls
     span = jnp.full(shape, fr.ref.share_span or 0, jnp.int32)
     return (

@@ -130,3 +130,36 @@ def syrk_triangular(n: int = 128) -> LoopNestSpec:
         arrays=(("C", n * n), ("A", n * n)),
         nests=(Loop(trip=n, body=(c01, accum)),),
     )
+
+
+def trmm(n: int = 128) -> LoopNestSpec:
+    """trmm, PolyBench 4.2: ``B := alpha*A*B`` with lower-triangular A.
+
+    The inner k loop runs ``k in [i+1, n)`` — a varying START as well as a
+    varying trip: ``start=1, start_coef=1, bound_coef=(n-1, -1)``
+    (spec.Loop).  Per (i, j): the k-loop accumulates
+    ``B[i][j] += A[k][i]*B[k][j]`` (loads A, B[k][j], B[i][j]; store), then
+    ``B[i][j] *= alpha`` (load + store).  ``B0 = B[k][j]`` is the
+    cross-thread reference (its address has no parallel-iterator term, like
+    GEMM's B0).
+    """
+    span = share_span_formula(n)
+    b_ij = lambda nm: Ref(nm, "B", addr_terms=((0, n), (1, 1)))
+    kloop = Loop(
+        trip=max(n - 1, 1), start=1, step=1,
+        bound_coef=(n - 1, -1), start_coef=1,
+        body=(
+            Ref("A0", "A", addr_terms=((2, n), (0, 1))),
+            Ref("B0", "B", addr_terms=((2, n), (1, 1)), share_span=span),
+            b_ij("B1"),
+            b_ij("B2"),
+        ),
+    )
+    nest = Loop(trip=n, body=(
+        Loop(trip=n, body=(kloop, b_ij("B3"), b_ij("B4"))),
+    ))
+    return LoopNestSpec(
+        name=f"trmm{n}",
+        arrays=(("A", n * n), ("B", n * n)),
+        nests=(nest,),
+    )

@@ -96,7 +96,16 @@ def _load():
 
 
 def spec_tokens(spec: LoopNestSpec) -> np.ndarray:
-    """Marshal a spec into the token grammar of ``pluss_rt.hpp``."""
+    """Marshal a spec into the token grammar of ``pluss_rt.hpp``.
+
+    Runs the same structural validation as the engine (``flatten_nest``:
+    no bounds on the parallel loop, no nested bounded loops, bounds within
+    [0, trip]) so the native twin REJECTS exactly what the engine rejects
+    instead of silently interpreting an invalid spec rectangularly."""
+    from pluss.spec import flatten_nest
+
+    for nest in spec.nests:
+        flatten_nest(nest)
     toks: list[int] = [len(spec.nests)]
 
     def emit(item) -> None:
@@ -110,14 +119,16 @@ def spec_tokens(spec: LoopNestSpec) -> np.ndarray:
             ])
             for depth, coef in item.addr_terms:
                 toks.extend([depth, coef])
-        elif item.bound_coef is not None:
+        elif item.bound_coef is not None or item.start_coef:
             # triangular loop: token type 2 carries the (a, b) bound
-            # (effective trip a + b*k at parallel index k)
+            # (effective trip a + b*k at parallel index k) and the start
+            # slope (first value start + start_coef*k); a varying start
+            # with a fixed trip ships the synthetic constant bound (trip, 0)
+            a, b = item.bound_coef or (item.trip, 0)
             toks.extend([2, item.trip, item.start, item.step,
-                         item.bound_coef[0], item.bound_coef[1],
-                         len(item.body)])
-            for b in item.body:
-                emit(b)
+                         a, b, item.start_coef, len(item.body)])
+            for bd in item.body:
+                emit(bd)
         else:
             toks.extend([0, item.trip, item.start, item.step, len(item.body)])
             for b in item.body:

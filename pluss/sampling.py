@@ -50,8 +50,12 @@ from pluss.spec import LoopNestSpec
 @functools.lru_cache(maxsize=64)
 def _plan_cached(spec: LoopNestSpec, cfg: SamplerConfig,
                  window_accesses: int | None):
-    """One plan per (spec, cfg, span) — shared by every nest's window fn."""
-    return plan(spec, cfg, window_accesses=window_accesses)
+    """One plan per (spec, cfg, span) — shared by every nest's window fn.
+
+    Templates are skipped: every sampled window walks the fresh-carry sort
+    path, so the host-side template analysis would be pure waste."""
+    return plan(spec, cfg, window_accesses=window_accesses,
+                build_templates=False)
 
 
 @functools.lru_cache(maxsize=64)

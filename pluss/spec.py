@@ -75,6 +75,11 @@ class Loop:
     AFFINE in ``k``, which is what lets the engine enumerate triangular
     nests with the same iota arithmetic as rectangular ones (plus one
     per-thread clock table for the varying per-iteration body size).
+
+    ``start_coef``: the loop's first VALUE is ``start + start_coef*k`` —
+    upper-triangular iteration like trmm's ``k in [i+1, m)`` is
+    ``start=1, start_coef=1, bound_coef=(m-1, -1)``.  Affects addresses only
+    (iteration values), never stream positions.
     """
 
     trip: int
@@ -82,6 +87,7 @@ class Loop:
     start: int = 0
     step: int = 1
     bound_coef: tuple[int, int] | None = None
+    start_coef: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -186,15 +192,17 @@ class FlatRef:
     pos_strides_k: tuple[int, ...] = ()
     offset_k: int = 0
     bounds: tuple[tuple[int, int] | None, ...] = ()
+    #: per-level start slope: iv[l] = starts[l] + starts_k[l]*k + idx[l]*steps[l]
+    starts_k: tuple[int, ...] = ()
 
 
 def flatten_nest(nest: Loop) -> list[FlatRef]:
     """Flatten one parallel nest into per-reference affine occurrence specs."""
     out: list[FlatRef] = []
-    if nest.bound_coef is not None:
+    if nest.bound_coef is not None or nest.start_coef:
         raise ValueError(
-            "the parallel (outermost) loop must be rectangular; bound_coef is "
-            "for inner loops"
+            "the parallel (outermost) loop must be rectangular; bound_coef/"
+            "start_coef are for inner loops"
         )
 
     def check_bound(loop: Loop) -> None:
@@ -242,6 +250,7 @@ def flatten_nest(nest: Loop) -> list[FlatRef]:
                         pos_strides_k=tuple(s[1] for s in s_aff),
                         offset_k=off1 + b_off1,
                         bounds=tuple(l.bound_coef for l in chain),
+                        starts_k=tuple(l.start_coef for l in chain),
                     )
                 )
                 b_off0 += 1

@@ -82,15 +82,19 @@ class OracleSampler:
         if isinstance(item, Ref):
             self._access(tid, item, ivs)
         else:
-            trip = item.trip
-            if item.bound_coef is not None:
-                # triangular inner loop: effective trip = a + b*k with k the
-                # parallel INDEX of this nest iteration (spec.Loop.bound_coef)
-                a, b = item.bound_coef
+            trip, start = item.trip, item.start
+            if item.bound_coef is not None or item.start_coef:
+                # triangular inner loop: effective trip a + b*k, start
+                # value start + start_coef*k, with k the parallel INDEX of
+                # this nest iteration (spec.Loop.bound_coef/start_coef)
                 pstart, pstep = self._pnest
-                trip = a + b * ((ivs[0] - pstart) // pstep)
+                k0 = (ivs[0] - pstart) // pstep
+                if item.bound_coef is not None:
+                    a, b = item.bound_coef
+                    trip = a + b * k0
+                start = start + item.start_coef * k0
             for i in range(trip):
-                v = item.start + i * item.step
+                v = start + i * item.step
                 for b in item.body:
                     self._walk_dispatch(tid, b, ivs + [v])
 

@@ -61,17 +61,20 @@ def test_two_process_shard_run_matches_engine(tmp_path):
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "JAX_ENABLE_X64": "1",
            "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
+    # workers log to FILES: draining two interdependent SPMD processes
+    # through pipes sequentially can deadlock on a full pipe buffer
+    logs = [tmp_path / f"worker{i}.log" for i in range(2)]
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(portno), str(i), str(out)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True,
+            env=env, stdout=open(logs[i], "w"), stderr=subprocess.STDOUT,
         )
         for i in range(2)
     ]
-    outs = [p.communicate(timeout=600) for p in procs]
-    for p, (so, se) in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{so[-2000:]}\n{se[-2000:]}"
+    for p, lg in zip(procs, logs):
+        p.wait(timeout=600)
+        assert p.returncode == 0, \
+            f"worker failed:\n{lg.read_text()[-2000:]}"
     got = json.load(open(out))
 
     from pluss.config import SamplerConfig

@@ -18,10 +18,10 @@ from pluss.spec import Loop, LoopNestSpec, Ref
 from tests.oracle import OracleSampler
 
 
-def _max_addr(ref: Ref, trips: list[int]) -> int:
-    """Largest address the ref can touch (coefs are nonneg, ivs 0-based)."""
+def _max_addr(ref: Ref, max_ivs: list[int]) -> int:
+    """Largest address the ref can touch (coefs are nonneg)."""
     return ref.addr_base + sum(
-        c * (trips[d] - 1) for d, c in ref.addr_terms if c > 0
+        c * max_ivs[d] for d, c in ref.addr_terms if c > 0
     )
 
 
@@ -34,15 +34,35 @@ def specs(draw):
     maxes = {nm: 0 for nm in names}
     ref_id = [0]
 
-    def gen_loop(depth: int, trips: list[int]) -> Loop:
+    def gen_loop(depth: int, trips: list[int], max_ivs: list[int],
+                 inside_bounded: bool = False) -> Loop:
         trip = draw(st.integers(2, 6))
         trips = trips + [trip]
+        # triangular inner loops (Loop.bound_coef): effective trip a + b*k
+        # over the parallel index k; never at the root, never nested inside
+        # another bounded loop, and always within [0, trip]
+        bound = None
+        start_coef = 0
+        if depth >= 1 and not inside_bounded and draw(st.booleans()):
+            ptrip = trips[0]
+            b = draw(st.sampled_from([1, -1]))
+            if b == 1 and trip >= ptrip:
+                bound = (draw(st.integers(1, trip - (ptrip - 1))), 1)
+            elif b == -1 and trip >= ptrip - 1:
+                bound = (draw(st.integers(ptrip - 1, trip)), -1)
+        if depth >= 1:
+            # varying start (trmm-style k in [i+1, ...)), with or without a
+            # varying trip; shifts iteration VALUES (addresses), not counts
+            start_coef = draw(st.sampled_from([0, 0, 1]))
+        max_ivs = max_ivs + [start_coef * (trips[0] - 1 if depth else 0)
+                             + trip - 1]
         body = []
         n_items = draw(st.integers(1, 3))
         for _ in range(n_items):
             deeper = depth < 2 and draw(st.booleans())
             if deeper:
-                body.append(gen_loop(depth + 1, trips))
+                body.append(gen_loop(depth + 1, trips, max_ivs,
+                                     inside_bounded or bound is not None))
             else:
                 nm = names[draw(st.integers(0, n_arrays - 1))]
                 n_terms = draw(st.integers(0, len(trips)))
@@ -62,12 +82,13 @@ def specs(draw):
                     ),
                 )
                 ref_id[0] += 1
-                maxes[nm] = max(maxes[nm], _max_addr(ref, trips))
+                maxes[nm] = max(maxes[nm], _max_addr(ref, max_ivs))
                 body.append(ref)
-        return Loop(trip=trip, body=tuple(body))
+        return Loop(trip=trip, body=tuple(body), bound_coef=bound,
+                    start_coef=start_coef)
 
     for _ in range(n_nests):
-        nests.append(gen_loop(0, []))
+        nests.append(gen_loop(0, [], []))
     arrays = tuple((nm, maxes[nm] + 1) for nm in names)
     return LoopNestSpec(name="prop", arrays=arrays, nests=tuple(nests))
 

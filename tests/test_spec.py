@@ -16,12 +16,14 @@ def interpret(nest: Loop):
         if isinstance(item, Ref):
             out.append((item, tuple(ivs)))
             return
-        trip = item.trip
+        trip, start = item.trip, item.start
         if item.bound_coef is not None:
             a, b = item.bound_coef
             trip = a + b * k0
+        if item.start_coef:
+            start = start + item.start_coef * k0
         for i in range(trip):
-            v = item.start + i * item.step
+            v = start + i * item.step
             for b_ in item.body:
                 walk(b_, ivs + [v], i if k0 is None else k0)
 
@@ -59,7 +61,9 @@ def flat_positions(nest: Loop):
                 i * (s0 + s1 * k)
                 for i, s0, s1 in zip(idxs[1:], fr.pos_strides[1:], sk[1:])
             )
-            ivs = tuple(st + i * sp for st, i, sp in zip(fr.starts, idxs, fr.steps))
+            stk = fr.starts_k or (0,) * len(fr.trips)
+            ivs = tuple(st + sc * k + i * sp for st, sc, i, sp
+                        in zip(fr.starts, stk, idxs, fr.steps))
             addr = fr.ref.addr_base + sum(c * v for c, v in zip(fr.addr_coefs, ivs))
             entries[pos] = (fr.ref.name, ivs[: len(fr.trips)], addr)
     return entries

@@ -92,6 +92,35 @@ def test_sampled_run_single_window_exact():
     assert np.array_equal(est.noshare_dense, full.noshare_dense)
 
 
+@pytest.mark.parametrize("n,cls", [(8, 8), (13, 64)])
+def test_trmm_matches_oracle(n, cls):
+    # varying START (k from i+1) on top of the varying trip: Loop.start_coef
+    from pluss.models import trmm
+
+    spec = trmm(n)
+    cfg = SamplerConfig(cls=cls)
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+def test_trmm_shard_matches_engine():
+    from pluss.models import trmm
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    spec = trmm(16)
+    cfg = SamplerConfig(cls=8)
+    a = engine.run(spec, cfg)
+    b = shard_run(spec, cfg, mesh=default_mesh(4), window_accesses=1)
+    assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
+    assert a.share_raw == b.share_raw
+
+
+def test_start_coef_root_rejected():
+    with pytest.raises(ValueError, match="outermost"):
+        flatten_nest(Loop(trip=4, start_coef=1, body=(
+            Ref("X0", "X", addr_terms=((0, 4),)),
+        )))
+
+
 def test_lower_triangular_bound():
     # b < 0: j runs n-k iterations (the other triangle); engine == oracle
     n = 8
@@ -103,6 +132,19 @@ def test_lower_triangular_bound():
     spec = LoopNestSpec(name="lowtri", arrays=(("X", n * n),), nests=(nest,))
     cfg = SamplerConfig(cls=8)
     assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+def test_native_rejects_what_engine_rejects():
+    # spec_tokens runs flatten_nest validation: the native twin must not
+    # silently interpret an invalid spec rectangularly (code-review r2)
+    bad = LoopNestSpec(
+        name="bad", arrays=(("X", 16),),
+        nests=(Loop(trip=4, bound_coef=(1, 1), body=(
+            Ref("X0", "X", addr_terms=((0, 4),)),
+        )),),
+    )
+    with pytest.raises(ValueError, match="outermost"):
+        native.spec_tokens(bad)
 
 
 def test_validation_errors():
