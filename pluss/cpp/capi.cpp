@@ -98,4 +98,25 @@ void* pluss_replay(const long long* addrs, long long n, int cls,
 
 void pluss_destroy(void* hp) { delete static_cast<Handle*>(hp); }
 
+// Fused trace-batch mapper for the streaming replay's single-cluster fast
+// path (pluss/trace.py _Compactor): little-endian u64 byte addresses ->
+// dense int32 line ids in ONE branchless pass (the numpy route is 4+
+// full-array passes, and the host core is shared with the PJRT client).
+// Returns 1 when every line falls inside [start, start+width) — else 0 and
+// the caller falls back to the general cluster probe.
+int pluss_map_lines(const unsigned long long* raw, long long n, int shift,
+                    long long start, long long width, long long base,
+                    int* out) {
+  long long ok = 1;
+  long long rebase = base - start;
+  for (long long i = 0; i < n; ++i) {
+    long long line = static_cast<long long>(raw[i] >> shift);
+    long long off = line - start;
+    ok &= static_cast<long long>(off >= 0) &
+          static_cast<long long>(off < width);
+    out[i] = static_cast<int>(line + rebase);
+  }
+  return static_cast<int>(ok);
+}
+
 }  // extern "C"

@@ -56,6 +56,7 @@ from pluss.spec import (
     FlatRef,
     LoopNestSpec,
     flatten_nest,
+    nest_has_varying_start,
     nest_iteration_size,
     nest_iteration_size_affine,
 )
@@ -469,7 +470,12 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         # invariance outright; the sort path handles both.  Oversize windows
         # would make the host-side template analysis itself the bottleneck —
         # skip it and let the device sort.
+        # varying trips (n1 != 0) AND varying starts both break the
+        # shift-invariance the template rests on — a start_coef loop with a
+        # FIXED trip would otherwise slip through the n1 gate with wrong
+        # addresses (its iteration values move with the parallel index)
         if build_templates and asg is None and n1 == 0 and \
+                not nest_has_varying_start(spec.nests[ni]) and \
                 W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW:
             tpl_refs, split_var = _split_ref_groups(refs, sched, cfg)
             if tpl_refs:

@@ -132,6 +132,44 @@ def syrk_triangular(n: int = 128) -> LoopNestSpec:
     )
 
 
+def symm(n: int = 128) -> LoopNestSpec:
+    """symm, PolyBench 4.2: ``C := alpha*A*B + beta*C`` with symmetric A.
+
+    Per (i, j): the bounded k-loop (``k < i`` — ``bound_coef=(0, 1)``, zero
+    trip at i=0) does ``C[k][j] += alpha*B[i][j]*A[i][k]`` (loads B, A,
+    C[k][j]; store) and accumulates ``temp2 += B[k][j]*A[i][k]`` (loads B,
+    A — temp2 is a register, not modeled, per the generated-sampler style
+    that only walks array refs); then the tail statement loads
+    ``B[i][j]``, ``A[i][i]`` (diagonal: one squared-index-free term
+    ``i*(n+1)``), ``C[i][j]`` and stores ``C[i][j]``.
+    ``B0 = B[k][j]`` is the cross-thread reference.
+    """
+    span = share_span_formula(n)
+    kloop = Loop(
+        trip=max(n - 1, 1), bound_coef=(0, 1),
+        body=(
+            Ref("B1", "B", addr_terms=((0, n), (1, 1))),
+            Ref("A0", "A", addr_terms=((0, n), (2, 1))),
+            Ref("C0", "C", addr_terms=((2, n), (1, 1))),
+            Ref("C1", "C", addr_terms=((2, n), (1, 1))),
+            Ref("B0", "B", addr_terms=((2, n), (1, 1)), share_span=span),
+            Ref("A1", "A", addr_terms=((0, n), (2, 1))),
+        ),
+    )
+    tail = (
+        Ref("B2", "B", addr_terms=((0, n), (1, 1))),
+        Ref("A2", "A", addr_terms=((0, n + 1),)),
+        Ref("C2", "C", addr_terms=((0, n), (1, 1))),
+        Ref("C3", "C", addr_terms=((0, n), (1, 1))),
+    )
+    nest = Loop(trip=n, body=(Loop(trip=n, body=(kloop,) + tail),))
+    return LoopNestSpec(
+        name=f"symm{n}",
+        arrays=(("C", n * n), ("A", n * n), ("B", n * n)),
+        nests=(nest,),
+    )
+
+
 def trmm(n: int = 128) -> LoopNestSpec:
     """trmm, PolyBench 4.2: ``B := alpha*A*B`` with lower-triangular A.
 

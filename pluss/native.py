@@ -91,8 +91,41 @@ def _load():
                                  ctypes.c_longlong]
     lib.pluss_destroy.restype = None
     lib.pluss_destroy.argtypes = [ctypes.c_void_p]
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.pluss_map_lines.restype = ctypes.c_int
+    lib.pluss_map_lines.argtypes = [
+        u64p, ctypes.c_longlong, ctypes.c_int, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_longlong, i32p,
+    ]
     _lib = lib
     return lib
+
+
+def line_mapper():
+    """The fused trace-batch mapper, or None when the toolchain is absent.
+
+    ``map_lines(raw_u64, shift, start, width, base) -> int32 ids | None``
+    (None = some line fell outside the cluster; caller probes generally).
+    """
+    try:
+        if not available(autobuild=True):
+            return None
+    except RuntimeError:
+        return None
+    lib = _load()
+
+    def map_lines(raw: np.ndarray, shift: int, start: int, width: int,
+                  base: int):
+        out = np.empty(len(raw), np.int32)
+        ok = lib.pluss_map_lines(
+            raw.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(raw), shift, start, width, base,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out if ok else None
+
+    return map_lines
 
 
 def spec_tokens(spec: LoopNestSpec) -> np.ndarray:

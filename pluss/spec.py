@@ -276,6 +276,19 @@ def nest_iteration_size(nest: Loop) -> int:
     return max(n0, n0 + n1 * (nest.trip - 1))
 
 
+def nest_has_varying_start(nest: Loop) -> bool:
+    """True when any loop in the nest has a nonzero ``start_coef`` — such
+    nests break the template path's shift-invariance even when their trip
+    counts are constant (n1 == 0), because iteration VALUES (addresses)
+    shift with the parallel index."""
+    def walk(item) -> bool:
+        if isinstance(item, Ref):
+            return False
+        return bool(item.start_coef) or any(walk(b) for b in item.body)
+
+    return walk(nest)
+
+
 def nest_iteration_size_affine(nest: Loop) -> tuple[int, int]:
     """Accesses per parallel iteration as ``n0 + n1*k`` (n1 != 0 marks a
     triangular nest)."""

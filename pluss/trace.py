@@ -179,6 +179,23 @@ class _Compactor:
         self.widths = np.empty(0, np.int64)   # id slots allocated
         self.bases = np.empty(0, np.int64)    # cluster's first id
         self.next_free = 0
+        self._native = None  # lazy: pluss.native.line_mapper()
+
+    def map_raw(self, raw: np.ndarray, shift: int) -> np.ndarray | None:
+        """Fused native fast path: u64 byte addresses -> int32 ids in one
+        C pass, valid only while the table holds a single cluster that
+        covers the whole chunk.  Returns None to fall back to
+        ``map(lines)`` (which also discovers new clusters)."""
+        if len(self.starts) != 1:
+            return None
+        if self._native is None:
+            from pluss import native
+
+            self._native = native.line_mapper() or False
+        if self._native is False:
+            return None
+        return self._native(raw, shift, int(self.starts[0]),
+                            int(self.widths[0]), int(self.bases[0]))
 
     def _map_into(self, chunk, out):
         cl = np.searchsorted(self.starts, chunk, side="right") - 1
@@ -305,9 +322,11 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
             # never read past n: a limit_refs prefix must not compact (or
             # grow the device table with) addresses it will mask out anyway
             raw = np.fromfile(f, dtype="<u8", count=min(batch, n - b * batch))
-            lines = raw.astype(np.int64) if precompacted \
-                else raw.astype(np.int64) >> shift
-            ids = comp.map(lines)
+            ids = comp.map_raw(raw, 0 if precompacted else shift)
+            if ids is None:
+                lines = raw.astype(np.int64) if precompacted \
+                    else raw.astype(np.int64) >> shift
+                ids = comp.map(lines)
             if comp.next_free > capacity:
                 while capacity < comp.next_free:
                     capacity *= 2

@@ -114,6 +114,52 @@ def test_trmm_shard_matches_engine():
     assert a.share_raw == b.share_raw
 
 
+@pytest.mark.parametrize("n", [8, 13])
+def test_symm_matches_oracle(n):
+    # symm's k-loop has bound (0, 1): ZERO iterations at i=0 — the empty
+    # bounded-window edge — plus a cross-row store C[k][j] and the diagonal
+    # ref A[i][i]
+    from pluss.models import symm
+
+    spec = symm(n)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+def test_symm_shard_matches_engine():
+    from pluss.models import symm
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    spec = symm(16)
+    cfg = SamplerConfig()
+    a = engine.run(spec, cfg)
+    b = shard_run(spec, cfg, mesh=default_mesh(4), window_accesses=1)
+    assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
+    assert a.share_raw == b.share_raw
+
+
+def test_start_coef_fixed_trip_excluded_from_templates():
+    # regression (code-review r2): a varying-START loop with a FIXED trip
+    # has n1 == 0 and used to slip through the template gate with wrong
+    # addresses; the nest must take the sort path and match the oracle
+    # at a template-eligible size with multiple windows
+    from pluss.engine import plan
+
+    n = 64
+    nest = Loop(trip=n, body=(
+        Loop(trip=4, start_coef=1, body=(
+            Ref("X0", "X", addr_terms=((1, 1),)),
+        )),
+    ))
+    spec = LoopNestSpec(name="varstart",
+                        arrays=(("X", n + 4),), nests=(nest,))
+    cfg = SamplerConfig(cls=8)
+    assert plan(spec, cfg).nests[0].tpl is None, "template must be skipped"
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+    assert_matches_oracle(spec, cfg,
+                          engine.run(spec, cfg, window_accesses=32))
+
+
 def test_start_coef_root_rejected():
     with pytest.raises(ValueError, match="outermost"):
         flatten_nest(Loop(trip=4, start_coef=1, body=(
