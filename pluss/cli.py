@@ -202,9 +202,17 @@ def main(argv: list[str] | None = None) -> int:
         from pluss.io import print_histogram
 
         # u64 files stream from disk in bounded memory (64 MB batches);
-        # text files are small by nature and go through the in-memory path
+        # text files are small by nature and go through the in-memory path.
+        # --backends shard (EXPLICIT, alone): device-sharded replay (segment
+        # scans + tail exchange over the mesh) — the scale-out variant; it
+        # holds the whole trace in host memory, so the default backend list
+        # (which merely contains "shard") must not select it
         t0 = time.perf_counter()
-        rep = trace_mod.replay_file(args.file, args.fmt, cls=cfg.cls)
+        if backends == ["shard"]:
+            rep = trace_mod.shard_replay(
+                trace_mod.load_trace(args.file, args.fmt), cls=cfg.cls)
+        else:
+            rep = trace_mod.replay_file(args.file, args.fmt, cls=cfg.cls)
         dt = time.perf_counter() - t0
         out.write(f"TPU TRACE: {dt:0.6f}\n")
         print_histogram("Start to dump reuse time", rep.histogram(), out)

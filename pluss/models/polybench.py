@@ -150,8 +150,11 @@ def symm(n: int = 128) -> LoopNestSpec:
         body=(
             Ref("B1", "B", addr_terms=((0, n), (1, 1))),
             Ref("A0", "A", addr_terms=((0, n), (2, 1))),
-            Ref("C0", "C", addr_terms=((2, n), (1, 1))),
-            Ref("C1", "C", addr_terms=((2, n), (1, 1))),
+            # C[k][j] and B[k][j] have no parallel-iterator term: their
+            # reuses cross simulated threads, so both carry the span
+            # (module convention — the structural twins of GEMM's B0)
+            Ref("C0", "C", addr_terms=((2, n), (1, 1)), share_span=span),
+            Ref("C1", "C", addr_terms=((2, n), (1, 1)), share_span=span),
             Ref("B0", "B", addr_terms=((2, n), (1, 1)), share_span=span),
             Ref("A1", "A", addr_terms=((0, n), (2, 1))),
         ),

@@ -36,7 +36,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from pluss.config import NBINS
-from pluss.ops.reuse import event_histogram, sort_stream, window_events
+from pluss.ops.reuse import (
+    bin_histogram,
+    event_histogram,
+    log2_bin,
+    sort_stream,
+    window_events,
+)
 
 #: default accesses per device window; 2^20 wins the sort-cost vs
 #: scan-step-count tradeoff on TPU (measured 2026-07-30)
@@ -141,22 +147,25 @@ def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
     if n == 0:
         return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)
     lines = addrs.astype(np.int64) if precompacted else lines_of(addrs, cls)
+    ids, n_lines = _compact(lines, window)
+    return _replay_ids(ids, n_lines, n, window)
 
-    # dense-range shortcut: when the touched lines span a small range the
-    # offset IS the id — no vocabulary pass at all (last_pos is sized by the
-    # range; untouched slots just stay -1)
+
+def _compact(lines: np.ndarray, window: int) -> tuple[np.ndarray, int]:
+    """Dense int32 ids + table size for a whole line array.
+
+    Dense-range shortcut: when the touched lines span a small range the
+    offset IS the id — no vocabulary pass at all (last_pos is sized by the
+    range; untouched slots just stay -1).  Otherwise incremental cluster
+    probing (the streaming path with one source)."""
     lo_line, hi_line = int(lines.min()), int(lines.max())
     if hi_line - lo_line < 1 << 24:
-        ids = (lines - lo_line).astype(np.int32)
-        return _replay_ids(ids, int(hi_line - lo_line + 1), n, window)
-
-    # host compaction by cluster probing; the compactor is incremental, so
-    # the whole-array path here is just the streaming path with one source
+        return (lines - lo_line).astype(np.int32), hi_line - lo_line + 1
     comp = _Compactor()
-    ids = np.empty(n, np.int32)
-    for lo in range(0, n, window):
+    ids = np.empty(len(lines), np.int32)
+    for lo in range(0, len(lines), window):
         ids[lo:lo + window] = comp.map(lines[lo:lo + window])
-    return _replay_ids(ids, comp.next_free, n, window)
+    return ids, comp.next_free
 
 
 class _Compactor:
@@ -343,6 +352,104 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                 pdt.type(n),
             )
     return ReplayResult(np.asarray(hist, np.int64), n, comp.next_free)
+
+
+def shard_replay(addrs: np.ndarray, cls: int = 64, mesh=None,
+                 window: int = TRACE_WINDOW,
+                 precompacted: bool = False) -> ReplayResult:
+    """Replay one address stream SHARDED over a device mesh.
+
+    The strict scan carry would serialize the stream; instead each device
+    scans a contiguous segment of it, capturing accesses with no in-segment
+    predecessor as HEADS, and one ``all_gather`` + masked-max over earlier
+    segments resolves them against the carried tail tables — the same
+    boundary exchange as the static shard backend
+    (:mod:`pluss.parallel.shard`), collectives-only and therefore
+    multi-host-ready.  Exact, not approximate: bit-identical to
+    :func:`replay`.  This is the long-stream scale-out story for the trace
+    path (BASELINE config 5 at pod scale); :func:`replay_file` remains the
+    bounded-host-memory story.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from pluss.parallel.shard import _vary, default_mesh
+
+    mesh = mesh or default_mesh()
+    D = mesh.devices.size
+    if D == 1:
+        return replay(addrs, cls, window, precompacted)
+    addrs = np.asarray(addrs)
+    if addrs.ndim != 1:
+        raise ValueError("trace must be a 1-D address stream")
+    n = addrs.shape[0]
+    if n == 0:
+        return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)
+    lines = addrs.astype(np.int64) if precompacted else lines_of(addrs, cls)
+    ids, n_lines = _compact(lines, window)
+
+    S = max(1, -(-n // (D * window)))
+    total = D * S * window
+    pos_dtype = "int32" if total < 2**31 - 2 else "int64"
+    if pos_dtype == "int64" and not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            f"trace of {n} accesses needs int64 positions; enable jax_enable_x64"
+        )
+    pdt = jnp.dtype(pos_dtype)
+    ids_pad = np.zeros(total, np.int32)
+    ids_pad[:n] = ids
+    ids3 = ids_pad.reshape(D, S, window)
+
+    def body(seg):
+        d = jax.lax.axis_index("d")
+        seg = seg[0]
+        # cast BEFORE multiplying: d is int32 (axis_index) and the product
+        # D*S*window is exactly what the int64 position path exists for
+        base = d.astype(pdt) * (S * window)
+        init = _vary((
+            jnp.full((n_lines,), -1, pdt),   # last_pos (ends as tails)
+            jnp.zeros((NBINS,), pdt),        # hist
+            jnp.full((n_lines,), -1, pdt),   # head_pos
+        ))
+
+        def step(carry, xs):
+            last_pos, hist, head_pos = carry
+            s, line_w = xs
+            pos_w = base + s.astype(pdt) * window + jnp.arange(window, dtype=pdt)
+            valid_w = pos_w < n
+            key_s, pos_s, span_s, valid_i = sort_stream(
+                line_w, pos_w, None, valid_w, pos_sorted=True)
+            ev, last_pos = window_events(key_s, pos_s, span_s, valid_i,
+                                         last_pos)
+            hist = hist + event_histogram(ev, include_cold=False)
+            # first-in-segment touches: unique per line across the scan, so
+            # the dump-slot permutation scatter applies (shard._capture_heads)
+            w = key_s.shape[0]
+            tgt = jnp.where(ev["cold"], key_s,
+                            n_lines + jnp.arange(w, dtype=key_s.dtype))
+            ext = jnp.concatenate([head_pos, jnp.zeros((w,), pdt)])
+            head_pos = ext.at[tgt].set(pos_s,
+                                       unique_indices=True)[:n_lines]
+            return (last_pos, hist, head_pos), None
+
+        (tail_pos, hist, head_pos), _ = jax.lax.scan(
+            step, init, (jnp.arange(S, dtype=jnp.int32), seg))
+        tails_all = jax.lax.all_gather(tail_pos, "d")       # [D, L]
+        earlier = jnp.arange(D) < d
+        prev = jnp.max(jnp.where(earlier[:, None], tails_all, -1), axis=0)
+        has_head = head_pos >= 0
+        evt = has_head & (prev >= 0)
+        cold = has_head & (prev < 0)
+        reuse = jnp.where(evt, head_pos - prev, 0)
+        bins = jnp.where(evt, log2_bin(reuse), 0)
+        hist = hist + bin_histogram(bins, evt.astype(pdt)).at[0].add(
+            cold.sum().astype(pdt))
+        return jax.lax.psum(hist, "d")
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("d"),
+                              out_specs=P()))
+    hist = f(ids3)
+    return ReplayResult(np.asarray(hist, np.int64), n, n_lines)
 
 
 def load_trace(path: str, fmt: str = "u64") -> np.ndarray:

@@ -126,6 +126,28 @@ def test_trace_mode(tmp_path, capsys):
     assert out.read_text().startswith("miss ratio")
 
 
+def test_trace_mode_shard_backend(tmp_path, capsys):
+    # --backends shard routes trace mode through the device-sharded replay;
+    # histogram lines must equal the streamed path's (table-slot diagnostic
+    # aside — the two compaction routes size their tables differently)
+    import numpy as np
+
+    from pluss import cli
+
+    path = tmp_path / "t.bin"
+    rng = np.random.default_rng(4)
+    (rng.integers(0, 512, 8000) * 64).astype("<u8").tofile(path)
+    outs = []
+    for be in ("vmap", "shard"):
+        cli.main(["trace", "--file", str(path), "--cpu", "--backends", be,
+                  "--out", str(tmp_path / f"m_{be}.csv")])
+        outs.append([l for l in capsys.readouterr().out.splitlines()
+                     if not l.startswith("TPU") and "lines" not in l])
+    assert outs[0] == outs[1]
+    assert (tmp_path / "m_vmap.csv").read_text() == \
+        (tmp_path / "m_shard.csv").read_text()
+
+
 def test_cli_window_and_start_point(capsys):
     from pluss import cli
 

@@ -95,6 +95,41 @@ def test_replay_dense_range_shortcut_offsets():
     assert res.histogram() == oracle_replay(addrs)
 
 
+@pytest.mark.parametrize("n_dev,n", [(8, 6000), (2, 4097)])
+def test_shard_replay_matches_replay(n_dev, n):
+    # sharded trace replay: per-device segment scans + tail exchange must be
+    # bit-identical to the sequential replay, incl. cross-segment reuses
+    # (hot lines recur everywhere) and the padded last segment
+    from pluss.parallel.shard import default_mesh
+
+    rng = np.random.default_rng(17)
+    addrs = rng.integers(0, 1 << 12, n) * 64  # hot: reuses cross segments
+    a = trace.replay(addrs, window=1 << 9)
+    b = trace.shard_replay(addrs, mesh=default_mesh(n_dev), window=1 << 9)
+    assert b.total_count == n
+    assert a.histogram() == b.histogram()
+
+
+def test_shard_replay_sparse_clusters():
+    from pluss.parallel.shard import default_mesh
+
+    rng = np.random.default_rng(23)
+    base = rng.integers(0, 1 << 44, 30, dtype=np.int64) * 64
+    addrs = base[rng.integers(0, 30, 5000)]
+    a = trace.replay(addrs, window=1 << 9)
+    b = trace.shard_replay(addrs, mesh=default_mesh(4), window=1 << 9)
+    assert a.histogram() == b.histogram()
+    assert a.n_lines == b.n_lines
+
+
+def test_shard_replay_single_device_falls_back():
+    from pluss.parallel.shard import default_mesh
+
+    addrs = np.array([0, 64, 0, 128, 64, 0], np.int64)
+    b = trace.shard_replay(addrs, mesh=default_mesh(1))
+    assert b.histogram() == {-1: 3.0, 2: 3.0}
+
+
 def test_replay_file_streams_matching_in_memory(tmp_path):
     # sparse clusters + tiny window + tiny initial capacity: exercises the
     # batched disk reads, the incremental compactor across batches, AND the
