@@ -208,11 +208,14 @@ def main(argv: list[str] | None = None) -> int:
         # holds the whole trace in host memory, so the default backend list
         # (which merely contains "shard") must not select it
         t0 = time.perf_counter()
+        win = args.window or trace_mod.TRACE_WINDOW
         if backends == ["shard"]:
             rep = trace_mod.shard_replay(
-                trace_mod.load_trace(args.file, args.fmt), cls=cfg.cls)
+                trace_mod.load_trace(args.file, args.fmt), cls=cfg.cls,
+                window=win)
         else:
-            rep = trace_mod.replay_file(args.file, args.fmt, cls=cfg.cls)
+            rep = trace_mod.replay_file(args.file, args.fmt, cls=cfg.cls,
+                                        window=win)
         dt = time.perf_counter() - t0
         out.write(f"TPU TRACE: {dt:0.6f}\n")
         print_histogram("Start to dump reuse time", rep.histogram(), out)

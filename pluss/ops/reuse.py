@@ -271,14 +271,14 @@ def share_unique(ev: dict, cap: int):
     is_evt = sv != sent
     boundary = jnp.concatenate([is_evt[:1], (sv[1:] != sv[:-1]) & is_evt[1:]])
     # unique b starts at the b-th boundary index; compact the first cap+1 of
-    # them with a second 1-operand sort (cheaper than the cumsum +
-    # segment-histogram alternative), then counts are adjacent differences,
-    # the last segment capped by the total event count
+    # them with top_k on the negated indices — O(n log cap), measurably
+    # cheaper than a second full sort at cap << window (TPU-measured; the
+    # scatter/cumsum alternative loses outright: TPU serializes scatters)
     n = sv.shape[0]
     idx = jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), n)
-    idx_s = jax.lax.sort(idx)
     if n < cap + 1:  # tiny windows: pad so the fixed-cap slices exist
-        idx_s = jnp.concatenate([idx_s, jnp.full((cap + 1 - n,), n, jnp.int32)])
+        idx = jnp.concatenate([idx, jnp.full((cap + 1 - n,), n, jnp.int32)])
+    idx_s = -jax.lax.top_k(-idx, cap + 1)[0]
     starts = idx_s[:cap]
     total = is_evt.sum().astype(jnp.int32)
     ends = jnp.minimum(idx_s[1:cap + 1], total)

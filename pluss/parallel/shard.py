@@ -116,11 +116,15 @@ def _capture_heads(head_pos, head_span, cold, key_s, pos_s, span_s,
     sub-windows (afterwards the carried table resolves it), so the update
     is a permutation: non-cold entries scatter into private dump slots past
     ``n_lines`` (the same trick as ops.reuse.window_events' tail update).
+    ``head_span``/``span_s`` may be None (the trace path has no share
+    classification).
     """
     w = key_s.shape[0]
     tgt = jnp.where(cold, key_s, n_lines + jnp.arange(w, dtype=key_s.dtype))
     ext_p = jnp.concatenate([head_pos, jnp.zeros((w,), head_pos.dtype)])
     head_pos = ext_p.at[tgt].set(pos_s, unique_indices=True)[:n_lines]
+    if head_span is None:
+        return head_pos, None
     ext_s = jnp.concatenate([head_span, jnp.zeros((w,), head_span.dtype)])
     head_span = ext_s.at[tgt].set(span_s, unique_indices=True)[:n_lines]
     return head_pos, head_span

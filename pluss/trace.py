@@ -373,7 +373,7 @@ def shard_replay(addrs: np.ndarray, cls: int = 64, mesh=None,
     import jax
     from jax.sharding import PartitionSpec as P
 
-    from pluss.parallel.shard import _vary, default_mesh
+    from pluss.parallel.shard import _capture_heads, _vary, default_mesh
 
     mesh = mesh or default_mesh()
     D = mesh.devices.size
@@ -422,14 +422,9 @@ def shard_replay(addrs: np.ndarray, cls: int = 64, mesh=None,
             ev, last_pos = window_events(key_s, pos_s, span_s, valid_i,
                                          last_pos)
             hist = hist + event_histogram(ev, include_cold=False)
-            # first-in-segment touches: unique per line across the scan, so
-            # the dump-slot permutation scatter applies (shard._capture_heads)
-            w = key_s.shape[0]
-            tgt = jnp.where(ev["cold"], key_s,
-                            n_lines + jnp.arange(w, dtype=key_s.dtype))
-            ext = jnp.concatenate([head_pos, jnp.zeros((w,), pdt)])
-            head_pos = ext.at[tgt].set(pos_s,
-                                       unique_indices=True)[:n_lines]
+            # first-in-segment touches: unique per line across the scan
+            head_pos, _ = _capture_heads(head_pos, None, ev["cold"],
+                                         key_s, pos_s, None, n_lines)
             return (last_pos, hist, head_pos), None
 
         (tail_pos, hist, head_pos), _ = jax.lax.scan(
