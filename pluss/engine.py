@@ -66,8 +66,13 @@ from pluss.spec import (
 WINDOW_TARGET = 1 << 23
 
 #: largest window the plan-time template analysis will host-lexsort; bigger
-#: windows (tiny meshes in n_windows mode) fall back to the device sort path
-MAX_TEMPLATE_WINDOW = 1 << 27
+#: windows fall back to the device sort path.  2^29 admits GEMM-4096, whose
+#: single chunk-round (268M accesses — windows never split a round) would
+#: OOM the device as one sort window but collapses to O(lines) under the
+#: template; the host lexsort is minutes once per (spec, cfg), cached.
+#: Ragged schedules beyond this size (no template possible) remain limited
+#: by device sort memory — a known bound of the round-window granularity.
+MAX_TEMPLATE_WINDOW = 1 << 29
 
 
 @dataclasses.dataclass(frozen=True)
@@ -255,6 +260,20 @@ def _split_ref_groups(refs: tuple[FlatRef, ...], sched,
     hoisted template.  Cross-array order is always rigid: line ids live in
     disjoint [base, base+count) ranges, and each eligible array's lines
     shift within its own range.
+
+    Negative result (round 2, measured on syrk): the obvious generalization
+    — decompose a mixed-coefficient group's dense per-window (head, tail)
+    view into an invariant base plus a rigidly-shifting block overlay, and
+    hoist it like the template — does NOT hold.  The interplay events
+    between the shifting ref (``A[i][k]``) and the sweeping ref
+    (``A[j][k]``) change STRUCTURE (which accesses pair up, not just their
+    values) with the absolute parallel index: e.g. ``A1``'s single visit to
+    block row ``i`` lands at sweep position ``j == i``, so per-line event
+    multisets differ across windows and neither value-affine fitting nor
+    rigid canonicalization aligns them (~15% of window events differ
+    non-affinely).  Hoisting those would need symbolic per-line case
+    analysis, not numeric verification — the sort path stays the honest
+    fallback for such groups.
     """
     bad: set[str] = set()
     coef_by_array: dict[str, int] = {}
