@@ -8,7 +8,8 @@ reference's only shipped workload; the others cover the BASELINE.json configs
 from pluss.models.gemm import gemm
 from pluss.models.linalg import (atax, bicg, doitgen, gemver, gesummv,
                                  jacobi2d, mvt)
-from pluss.models.polybench import mm2, mm3, symm, syrk, syrk_triangular, trmm
+from pluss.models.polybench import (covariance, mm2, mm3, symm, syrk,
+                                    syrk_triangular, trmm)
 from pluss.models.stencils import conv2d, fdtd2d, heat3d, stencil3d
 
 REGISTRY = {
@@ -19,6 +20,7 @@ REGISTRY = {
     "syrk_tri": syrk_triangular,
     "trmm": trmm,
     "symm": symm,
+    "covariance": covariance,
     "conv2d": conv2d,
     "stencil3d": stencil3d,
     "atax": atax,
@@ -35,5 +37,5 @@ REGISTRY = {
 __all__ = [
     "gemm", "mm2", "mm3", "syrk", "conv2d", "stencil3d",
     "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d",
-    "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm", "REGISTRY",
+    "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm", "covariance", "REGISTRY",
 ]

@@ -173,6 +173,47 @@ def symm(n: int = 128) -> LoopNestSpec:
     )
 
 
+def covariance(n: int = 128) -> LoopNestSpec:
+    """covariance, PolyBench 4.2 (the cov kernel's triangular nest).
+
+    ``for i: for (j = i; j < n; j++)`` — varying START and varying TRIP on
+    the same loop (``start_coef=1``, ``bound_coef=(n, -1)``).  Per (i, j):
+    zero-store ``cov[i][j]``; the k-loop accumulates
+    ``data[k][i]*data[k][j]`` re-loading/storing ``cov[i][j]`` each step
+    (generated-sampler style); then the two tail statements
+    ``cov[i][j] /= (float_n - 1)`` (load + store) and
+    ``cov[j][i] = cov[i][j]`` (load + symmetric store).
+    ``D1 = data[k][j]`` carries the share span: column ``j`` recurs across
+    parallel iterations (every ``i <= j`` revisits it), so its reuses cross
+    simulated threads, while ``D0 = data[k][i]``'s column IS the parallel
+    iterator — thread-private.
+    """
+    span = share_span_formula(n)
+    cov_ij = lambda nm: Ref(nm, "cov", addr_terms=((0, n), (1, 1)))
+    kloop = Loop(trip=n, body=(
+        Ref("D0", "data", addr_terms=((2, n), (0, 1))),
+        Ref("D1", "data", addr_terms=((2, n), (1, 1)), share_span=span),
+        cov_ij("C1"),
+        cov_ij("C2"),
+    ))
+    jloop = Loop(
+        trip=n, start_coef=1, bound_coef=(n, -1),
+        body=(
+            cov_ij("C0"),
+            kloop,
+            cov_ij("C3"),                                   # /= load
+            cov_ij("C4"),                                   # /= store
+            cov_ij("C5"),                                   # symm load
+            Ref("C6", "cov", addr_terms=((1, n), (0, 1))),  # cov[j][i] store
+        ),
+    )
+    return LoopNestSpec(
+        name=f"covariance{n}",
+        arrays=(("cov", n * n), ("data", n * n)),
+        nests=(Loop(trip=n, body=(jloop,)),),
+    )
+
+
 def trmm(n: int = 128) -> LoopNestSpec:
     """trmm, PolyBench 4.2: ``B := alpha*A*B`` with lower-triangular A.
 

@@ -138,6 +138,29 @@ def test_symm_shard_matches_engine():
     assert a.share_raw == b.share_raw
 
 
+@pytest.mark.parametrize("n", [8, 13])
+def test_covariance_matches_oracle(n):
+    # covariance: varying START and varying TRIP on the same loop
+    # (j = i .. n-1), plus the symmetric cross-row store cov[j][i]
+    from pluss.models import covariance
+
+    spec = covariance(n)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+def test_covariance_shard_matches_engine():
+    from pluss.models import covariance
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    spec = covariance(16)
+    cfg = SamplerConfig()
+    a = engine.run(spec, cfg)
+    b = shard_run(spec, cfg, mesh=default_mesh(4), window_accesses=1)
+    assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
+    assert a.share_raw == b.share_raw
+
+
 def test_start_coef_fixed_trip_excluded_from_templates():
     # regression (code-review r2): a varying-START loop with a FIXED trip
     # has n1 == 0 and used to slip through the template gate with wrong
