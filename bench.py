@@ -188,6 +188,15 @@ def bench_trace(n_refs: int) -> None:
 
 def main() -> int:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
+    # persistent XLA compilation cache: the flagship compiles cost minutes
+    # over the tunnel; caching them in-repo makes repeat bench runs (and the
+    # driver's round-end run on this same box) warm-start in seconds
+    import jax
+
+    os.makedirs(".bench/jit_cache", exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.abspath(".bench/jit_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     plat = probe_accelerator()
     if plat is None:
         from pluss.utils.platform import force_cpu
