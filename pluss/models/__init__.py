@@ -8,8 +8,8 @@ reference's only shipped workload; the others cover the BASELINE.json configs
 from pluss.models.gemm import gemm
 from pluss.models.linalg import (atax, bicg, doitgen, gemver, gesummv,
                                  jacobi2d, mvt)
-from pluss.models.polybench import (covariance, mm2, mm3, symm, syrk,
-                                    syrk_triangular, trmm)
+from pluss.models.polybench import (correlation, covariance, mm2, mm3,
+                                    symm, syrk, syrk_triangular, trmm)
 from pluss.models.stencils import conv2d, fdtd2d, heat3d, stencil3d
 
 REGISTRY = {
@@ -21,6 +21,7 @@ REGISTRY = {
     "trmm": trmm,
     "symm": symm,
     "covariance": covariance,
+    "correlation": correlation,
     "conv2d": conv2d,
     "stencil3d": stencil3d,
     "atax": atax,
@@ -37,5 +38,6 @@ REGISTRY = {
 __all__ = [
     "gemm", "mm2", "mm3", "syrk", "conv2d", "stencil3d",
     "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d",
-    "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm", "covariance", "REGISTRY",
+    "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm", "covariance", "correlation",
+    "REGISTRY",
 ]

@@ -214,6 +214,81 @@ def covariance(n: int = 128) -> LoopNestSpec:
     )
 
 
+def correlation(n: int = 128) -> LoopNestSpec:
+    """correlation, PolyBench 4.2 (square ``data`` for one size parameter).
+
+    Four parallel nests back-to-back — the longest nest chain in the model
+    zoo, mixing rectangular and triangular shapes: (1) column means over
+    ``data`` (parallel j, reduce over i; tail = the ``/= float_n``
+    load+store), (2) column stddevs (same shape, re-reading ``mean``;
+    tail = the ``/=``, ``sqrt`` and epsilon-clamp statements, each a
+    load+store of ``stddev[j]``), (3) the normalization sweep (parallel i
+    over rows: ``data[i][j] -= mean[j]`` then ``data[i][j] /= ...`` —
+    BOTH statements' load/load/store triples), (4) the correlation
+    triangle (parallel i, ``j = i+1 .. n-1`` via
+    ``start_coef``/``bound_coef``, covariance-style accumulation with the
+    symmetric store).  Statements are linearized generated-sampler style
+    (loads precede the store); the only non-modeled access is the scalar
+    epilogue ``corr[n-1][n-1] = 1``, which sits outside every parallel
+    nest.  Share spans follow the module convention (refs with no
+    parallel-iterator address term): nest 3's ``mean[j]``/``stddev[j]``
+    and nest 4's ``D5 = data[k][j]``.
+    """
+    span = share_span_formula(n)
+
+    def column_reduce(out: str, extra_inner: tuple, tail_pairs: int) -> Loop:
+        """``out[j] = 0; for i: out[j] += f(data[i][j], ...)`` plus
+        ``tail_pairs`` load+store tail statements on ``out[j]`` — the
+        shared shape of the mean and stddev nests."""
+        o = lambda k: Ref(f"{out}{k}", out, addr_terms=((0, 1),))
+        inner = Loop(trip=n, body=(
+            Ref(f"D_{out}", "data", addr_terms=((1, n), (0, 1))),
+            *extra_inner, o("_a"), o("_b"),
+        ))
+        tail = tuple(o(f"_t{i}") for i in range(2 * tail_pairs))
+        return Loop(trip=n, body=(o("_z"), inner) + tail)
+
+    n1 = column_reduce("mean", (), tail_pairs=1)
+    n2 = column_reduce(
+        "stddev", (Ref("M5", "mean", addr_terms=((0, 1),)),), tail_pairs=3)
+    data_ij = lambda nm: Ref(nm, "data", addr_terms=((0, n), (1, 1)))
+    n3 = Loop(trip=n, body=(
+        Loop(trip=n, body=(
+            data_ij("D2"),
+            Ref("M6", "mean", addr_terms=((1, 1),), share_span=span),
+            data_ij("D3"),
+            data_ij("D4"),
+            Ref("S5", "stddev", addr_terms=((1, 1),), share_span=span),
+            data_ij("D5n"),
+        )),
+    ))
+    corr_ij = lambda nm: Ref(nm, "corr", addr_terms=((0, n), (1, 1)))
+    n4 = Loop(trip=max(n - 1, 1), body=(
+        Ref("C0", "corr", addr_terms=((0, n + 1),)),   # corr[i][i] = 1
+        Loop(
+            trip=max(n - 1, 1), start=1, start_coef=1,
+            bound_coef=(n - 1, -1),
+            body=(
+                corr_ij("C1"),                          # corr[i][j] = 0
+                Loop(trip=n, body=(
+                    Ref("D4", "data", addr_terms=((2, n), (0, 1))),
+                    Ref("D5", "data", addr_terms=((2, n), (1, 1)),
+                        share_span=span),
+                    corr_ij("C2"), corr_ij("C3"),
+                )),
+                corr_ij("C4"),                          # symm load
+                Ref("C5", "corr", addr_terms=((1, n), (0, 1))),  # store ji
+            ),
+        ),
+    ))
+    return LoopNestSpec(
+        name=f"correlation{n}",
+        arrays=(("data", n * n), ("mean", n), ("stddev", n),
+                ("corr", n * n)),
+        nests=(n1, n2, n3, n4),
+    )
+
+
 def trmm(n: int = 128) -> LoopNestSpec:
     """trmm, PolyBench 4.2: ``B := alpha*A*B`` with lower-triangular A.
 

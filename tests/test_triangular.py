@@ -139,6 +139,29 @@ def test_symm_shard_matches_engine():
 
 
 @pytest.mark.parametrize("n", [8, 13])
+def test_correlation_matches_oracle(n):
+    # four nests back-to-back mixing rectangular and triangular shapes;
+    # cross-nest carried state through mean/stddev/data/corr
+    from pluss.models import correlation
+
+    spec = correlation(n)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+def test_correlation_shard_matches_engine():
+    from pluss.models import correlation
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    spec = correlation(16)
+    cfg = SamplerConfig()
+    a = engine.run(spec, cfg)
+    b = shard_run(spec, cfg, mesh=default_mesh(4), window_accesses=1)
+    assert a.noshare_dense.tolist() == b.noshare_dense.tolist()
+    assert a.share_raw == b.share_raw
+
+
+@pytest.mark.parametrize("n", [8, 13])
 def test_covariance_matches_oracle(n):
     # covariance: varying START and varying TRIP on the same loop
     # (j = i .. n-1), plus the symmetric cross-row store cov[j][i]
