@@ -1,4 +1,5 @@
-"""PolyBench 2mm / 3mm / syrk specs (BASELINE.json config 3).
+"""PolyBench specs: 2mm / 3mm / syrk (BASELINE.json config 3) plus the
+4.2 triangular family — syrk_tri, trmm, symm, covariance, correlation.
 
 The reference ships only the generated GEMM sampler; these specs are authored in
 the same ppcg/pluss style it was generated from (``/root/reference/c_lib/test/
@@ -10,7 +11,9 @@ re-loads and re-stores its output element each k iteration (GEMM's C2/C3 pair,
 Share spans follow the generated formula ``(trip+1)*trip+1`` of the j loop
 (``…omp.cpp:202``) and are attached to exactly the refs whose row index does not
 involve the parallel iterator — those are the reuses that cross simulated
-threads, as B0 does in GEMM (``gemm_sampler.rs:196-201``).
+threads, as B0 does in GEMM (``gemm_sampler.rs:196-201``).  (For triangular
+nests the criterion generalizes to: the ref's address recurs across
+parallel iterations — see each model's docstring.)
 
 ``syrk`` uses the rectangular (full-matrix) PolyBench 3.x form so all loops
 stay rectangular.  PolyBench 4.2's triangular ``j <= i`` variant needs
