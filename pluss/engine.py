@@ -56,6 +56,7 @@ from pluss.spec import (
     FlatRef,
     LoopNestSpec,
     flatten_nest,
+    nest_has_bounds,
     nest_has_varying_start,
     nest_iteration_size,
     nest_iteration_size_affine,
@@ -466,10 +467,11 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     acc = np.zeros((len(spec.nests), T), np.int64)  # true accesses per thread
     for ni, (sched, refs, body, asg, owned, W, NW) in enumerate(geom):
         n0, n1 = nest_iteration_size_affine(spec.nests[ni])
+        tri = nest_has_bounds(spec.nests[ni])
         tpl = clean = None
         var_refs = refs
         clock = None
-        if n1 != 0:
+        if tri:
             # triangular nest: per-iteration body size is affine in the
             # parallel index, so stream positions need a per-thread clock
             # table — the exclusive running access count at every (round,
@@ -489,11 +491,11 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         # invariance outright; the sort path handles both.  Oversize windows
         # would make the host-side template analysis itself the bottleneck —
         # skip it and let the device sort.
-        # varying trips (n1 != 0) AND varying starts both break the
-        # shift-invariance the template rests on — a start_coef loop with a
-        # FIXED trip would otherwise slip through the n1 gate with wrong
-        # addresses (its iteration values move with the parallel index)
-        if build_templates and asg is None and n1 == 0 and \
+        # any bounded loop (tri) and any varying start both break the
+        # shift-invariance the template rests on; both gates are keyed on
+        # the nest TREE, not on net-slope arithmetic — canceling sibling
+        # slopes and fixed-trip varying starts would slip through otherwise
+        if build_templates and asg is None and not tri and \
                 not nest_has_varying_start(spec.nests[ni]) and \
                 W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW:
             tpl_refs, split_var = _split_ref_groups(refs, sched, cfg)
@@ -507,7 +509,7 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                     var_refs = split_var
         nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean,
                               var_refs, clock))
-        if n1 == 0:  # triangular nests already counted via body_slot above
+        if not tri:  # triangular nests already counted via body_slot above
             for t in range(T):
                 for cid in owned[t]:
                     if cid >= 0:

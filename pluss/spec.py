@@ -276,17 +276,33 @@ def nest_iteration_size(nest: Loop) -> int:
     return max(n0, n0 + n1 * (nest.trip - 1))
 
 
-def nest_has_varying_start(nest: Loop) -> bool:
-    """True when any loop in the nest has a nonzero ``start_coef`` — such
-    nests break the template path's shift-invariance even when their trip
-    counts are constant (n1 == 0), because iteration VALUES (addresses)
-    shift with the parallel index."""
+def _nest_any(nest: Loop, pred) -> bool:
+    """True when ``pred(loop)`` holds for any loop in the nest tree."""
     def walk(item) -> bool:
         if isinstance(item, Ref):
             return False
-        return bool(item.start_coef) or any(walk(b) for b in item.body)
+        return pred(item) or any(walk(b) for b in item.body)
 
     return walk(nest)
+
+
+def nest_has_bounds(nest: Loop) -> bool:
+    """True when any loop in the nest is bounded (``bound_coef``).
+
+    This — not the NET body slope ``n1`` — must select the triangular
+    (clock-table) position path: sibling bounded loops with canceling
+    slopes (e.g. ``(1, 1)`` next to ``(1, -1)``) leave the total body size
+    constant while refs after the first sibling still have nonzero
+    ``offset_k``, which the rectangular closed form drops."""
+    return _nest_any(nest, lambda l: l.bound_coef is not None)
+
+
+def nest_has_varying_start(nest: Loop) -> bool:
+    """True when any loop in the nest has a nonzero ``start_coef`` — such
+    nests break the template path's shift-invariance even when their trip
+    counts are constant, because iteration VALUES (addresses) shift with
+    the parallel index."""
+    return _nest_any(nest, lambda l: bool(l.start_coef))
 
 
 def nest_iteration_size_affine(nest: Loop) -> tuple[int, int]:

@@ -213,6 +213,33 @@ def test_start_coef_root_rejected():
         )))
 
 
+def test_canceling_sibling_bounds():
+    # soak-found regression: two sibling bounded loops with OPPOSITE slopes
+    # leave the net body slope n1 == 0, but refs after the first sibling
+    # still have nonzero offset_k — the nest must take the clock-table path
+    # (and never the template), keyed on nest_has_bounds, not on n1
+    from pluss.engine import plan
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    spec = LoopNestSpec(name="cancel", arrays=(("X", 1),), nests=(
+        Loop(trip=2, body=(
+            Loop(trip=2, bound_coef=(1, 1),
+                 body=(Ref("R0", "X", addr_terms=()),)),
+            Loop(trip=2, bound_coef=(1, -1),
+                 body=(Ref("R1", "X", addr_terms=()),)),
+        )),
+    ))
+    cfg = SamplerConfig(thread_num=1, chunk_size=1, ds=8, cls=8)
+    pl = plan(spec, cfg)
+    assert pl.nests[0].clock is not None, "clock path must activate"
+    assert pl.nests[0].tpl is None, "template must be skipped"
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+    o = OracleSampler(spec, cfg).run()
+    for nd in (2, 8):
+        s = shard_run(spec, cfg, mesh=default_mesh(nd))
+        assert s.noshare_dict(0) == o.noshare[0], f"shard{nd}"
+
+
 def test_lower_triangular_bound():
     # b < 0: j runs n-k iterations (the other triangle); engine == oracle
     n = 8
