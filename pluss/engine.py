@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -519,6 +520,36 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     nest_base = np.zeros_like(acc)
     nest_base[1:] = np.cumsum(acc[:-1], axis=0)
     total = int(acc.sum())
+
+    # fail loudly when a device SORT window cannot fit: windows never split
+    # a chunk-round, so a huge body on a templateless (ragged/triangular)
+    # nest would otherwise surface as an opaque XLA out-of-memory at
+    # compile time.  The estimate covers the sorted operands (key, pos,
+    # span, valid) plus ghost entries and ~3x sort workspace.
+    limit = int(os.environ.get("PLUSS_MAX_SORT_WINDOW_BYTES", 8 << 30))
+    n_lines = spec.total_lines(cfg)
+    for ni, np_ in enumerate(nests):
+        streams = []
+        if not np_.ultra_windows().all():
+            streams.append(("sort", np_.refs))
+        if np_.var_refs and np_.tpl is not None:
+            streams.append(("template's var part", np_.var_refs))
+        for label, refs_ in streams:
+            entries = np_.window_rounds * cfg.chunk_size * sum(
+                int(np.prod(fr.trips[1:], dtype=np.int64)) for fr in refs_
+            ) + n_lines
+            # x T: the default vmap backend materializes every simulated
+            # thread's window concurrently
+            est = entries * (9 + pos_dtype.itemsize) * 4 * T
+            if est > limit:
+                raise RuntimeError(
+                    f"nest {ni}: one {label} window is ~{entries:,} entries "
+                    f"per thread (~{est / 2**30:.2f} GiB across {T} vmapped "
+                    f"threads with sort workspace), beyond the "
+                    f"{limit / 2**30:.2f} GiB device budget.  Use a static "
+                    "schedule (template path), a finer chunk size, or raise "
+                    "PLUSS_MAX_SORT_WINDOW_BYTES if the device can take it."
+                )
     return StreamPlan(
         spec=spec,
         cfg=cfg,

@@ -205,15 +205,21 @@ def test_oversize_stream_needs_x64():
         jax.config.update("jax_enable_x64", prev)
 
 
-def test_oversize_window_skips_template():
+def test_oversize_window_skips_template(monkeypatch):
     # a 1-window plan of GEMM-1024 (1.07e9 accesses/window) must not attempt
-    # the host template analysis; the sort path takes over
+    # the host template analysis; with an explicit device budget the sort
+    # path takes over, and with the DEFAULT budget the plan fails loudly
+    # instead of OOMing XLA (the window exceeds any real sort budget)
     from pluss.engine import MAX_TEMPLATE_WINDOW, plan
 
+    monkeypatch.setenv("PLUSS_MAX_SORT_WINDOW_BYTES", str(1 << 60))
     pl = plan(gemm(1024), n_windows=1)
     n = pl.nests[0]
     assert n.window_rounds * 4 * n.body > MAX_TEMPLATE_WINDOW
     assert n.tpl is None
+    monkeypatch.delenv("PLUSS_MAX_SORT_WINDOW_BYTES")
+    with pytest.raises(RuntimeError, match="device budget"):
+        plan(gemm(1024), n_windows=1)
 
 
 def test_nonzero_start_and_stride_matches_oracle():
@@ -256,3 +262,20 @@ def test_negative_step_matches_oracle():
         ),
     )
     assert_matches_oracle(spec, SamplerConfig(cls=8))
+
+
+def test_oversize_sort_window_fails_loudly(monkeypatch):
+    # a templateless (dynamic-assignment) nest whose single round exceeds
+    # the device sort budget must raise an actionable error at PLAN time,
+    # not an opaque XLA out-of-memory at compile time
+    from pluss.engine import plan
+    from pluss.sched import ChunkSchedule
+
+    monkeypatch.setenv("PLUSS_MAX_SORT_WINDOW_BYTES", str(1 << 20))
+    spec = gemm(64)
+    sched = ChunkSchedule(4, 64, 0, 1, 4)
+    asg = tuple((c + 1) % 4 for c in range(sched.n_chunks))
+    with pytest.raises(RuntimeError, match="device budget"):
+        plan(spec, assignment=(asg,))
+    monkeypatch.delenv("PLUSS_MAX_SORT_WINDOW_BYTES")
+    plan(spec, assignment=(asg,))  # default budget: fine
