@@ -404,6 +404,18 @@ def _nest_geometry(spec: LoopNestSpec, cfg: SamplerConfig, assignment,
     return out
 
 
+def sort_window_bytes(np_: NestPlan, cfg: SamplerConfig, pos_dtype,
+                      n_lines: int, refs=None) -> int:
+    """Estimated device bytes to sort ONE window of ``refs`` (default: the
+    nest's full ref set): sorted operands (key, pos, span, valid) plus
+    ghost entries, x4 for sort workspace."""
+    refs = np_.refs if refs is None else refs
+    entries = np_.window_rounds * cfg.chunk_size * sum(
+        int(np.prod(fr.trips[1:], dtype=np.int64)) for fr in refs
+    ) + n_lines
+    return entries * (9 + np.dtype(pos_dtype).itemsize) * 4
+
+
 def natural_n_windows(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                       assignment=None, start_point: int | None = None,
                       window_accesses: int | None = None) -> int:
@@ -424,7 +436,8 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
          start_point: int | None = None,
          window_accesses: int | None = None,
          n_windows: int | None = None,
-         build_templates: bool = True) -> StreamPlan:
+         build_templates: bool = True,
+         sort_concurrency: int | None = None) -> StreamPlan:
     """Build the static stream plan.
 
     ``assignment``: optional per-nest chunk->thread maps (dynamic scheduling);
@@ -524,31 +537,33 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     # fail loudly when a device SORT window cannot fit: windows never split
     # a chunk-round, so a huge body on a templateless (ragged/triangular)
     # nest would otherwise surface as an opaque XLA out-of-memory at
-    # compile time.  The estimate covers the sorted operands (key, pos,
-    # span, valid) plus ghost entries and ~3x sort workspace.
+    # compile time.  ``sort_concurrency``: how many such windows the caller
+    # materializes at once (the default vmap backend runs all T threads
+    # concurrently; the seq backend passes 1; the subset sampler re-checks
+    # with its own T x nsel fan-out).
     limit = int(os.environ.get("PLUSS_MAX_SORT_WINDOW_BYTES", 8 << 30))
+    conc = T if sort_concurrency is None else sort_concurrency
     n_lines = spec.total_lines(cfg)
     for ni, np_ in enumerate(nests):
         streams = []
         if not np_.ultra_windows().all():
-            streams.append(("sort", np_.refs))
+            streams.append(("sort", np_.refs,
+                            "a static schedule (template path), a finer "
+                            "chunk size"))
         if np_.var_refs and np_.tpl is not None:
-            streams.append(("template's var part", np_.var_refs))
-        for label, refs_ in streams:
-            entries = np_.window_rounds * cfg.chunk_size * sum(
-                int(np.prod(fr.trips[1:], dtype=np.int64)) for fr in refs_
-            ) + n_lines
-            # x T: the default vmap backend materializes every simulated
-            # thread's window concurrently
-            est = entries * (9 + pos_dtype.itemsize) * 4 * T
+            streams.append(("template's var (template-ineligible) part",
+                            np_.var_refs, "a finer chunk size"))
+        for label, refs_, remedy in streams:
+            est = sort_window_bytes(np_, cfg, pos_dtype, n_lines,
+                                    refs_) * conc
             if est > limit:
                 raise RuntimeError(
-                    f"nest {ni}: one {label} window is ~{entries:,} entries "
-                    f"per thread (~{est / 2**30:.2f} GiB across {T} vmapped "
-                    f"threads with sort workspace), beyond the "
-                    f"{limit / 2**30:.2f} GiB device budget.  Use a static "
-                    "schedule (template path), a finer chunk size, or raise "
-                    "PLUSS_MAX_SORT_WINDOW_BYTES if the device can take it."
+                    f"nest {ni}: the {label} window stream needs "
+                    f"~{est / 2**30:.2f} GiB across {conc} concurrent "
+                    f"windows (incl. sort workspace), beyond the "
+                    f"{limit / 2**30:.2f} GiB device budget.  Use {remedy}, "
+                    "or raise PLUSS_MAX_SORT_WINDOW_BYTES if the device "
+                    "can take it."
                 )
     return StreamPlan(
         spec=spec,
@@ -883,7 +898,8 @@ def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
     """(plan, jitted fn) for a workload; cached so repeat runs reuse the XLA
     executable (the reference's `speed` mode re-runs the same sampler 3x,
     main.rs:23-35).  The jitted fn returns the packed [T, L] result matrix."""
-    pl = plan(spec, cfg, assignment, start_point, window_accesses)
+    pl = plan(spec, cfg, assignment, start_point, window_accesses,
+              sort_concurrency=1 if backend == "seq" else None)
 
     if backend == "vmap":
         def f(tids):

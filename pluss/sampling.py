@@ -30,6 +30,7 @@ degenerates to the exact full enumeration.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +43,7 @@ from pluss.engine import (
     _sort_window,
     merge_share_windows,
     plan,
+    sort_window_bytes,
 )
 from pluss.ops.reuse import share_unique
 from pluss.spec import LoopNestSpec
@@ -113,6 +115,20 @@ def sampled_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         pl, fn = _window_fn(spec, cfg, ni, share_cap, window_accesses)
         NW = pl.nests[ni].n_windows
         nsel = max(1, round(rate * NW))
+        # the sampler vmaps over T x nsel fresh-carry windows at once — a
+        # fan-out plan()'s default guard cannot see; re-check here so huge
+        # selections fail actionably instead of OOMing XLA
+        est = sort_window_bytes(pl.nests[ni], cfg, pl.pos_dtype,
+                                pl.spec.total_lines(cfg)) * T * nsel
+        limit = int(os.environ.get("PLUSS_MAX_SORT_WINDOW_BYTES", 8 << 30))
+        if est > limit:
+            raise RuntimeError(
+                f"sampling nest {ni}: {nsel} windows x {T} threads need "
+                f"~{est / 2**30:.2f} GiB at once (incl. sort workspace), "
+                f"beyond the {limit / 2**30:.2f} GiB device budget.  Lower "
+                "the rate, shrink window_accesses, or raise "
+                "PLUSS_MAX_SORT_WINDOW_BYTES."
+            )
         sel = np.sort(rng.choice(NW, nsel, replace=False)).astype(np.int32)
         scale = NW / nsel
         dh, sv, sc, snu = fn(jnp.arange(T, dtype=jnp.int32),

@@ -118,6 +118,43 @@ def _assert_result_matches(r, o, cfg):
         assert r.share_dict(t) == want, f"tid {t} share"
 
 
+@st.composite
+def schedules(draw):
+    """(spec, cfg, assignment | None, start_point | None): random dynamic
+    chunk->thread maps (the C++-only FIFO capability as explicit maps) and
+    setStartPoint resume values — the schedule dimension on top of the
+    random spec shapes."""
+    from pluss.sched import ChunkSchedule
+
+    spec = draw(specs())
+    cfg = draw(configs())
+    asg = None
+    if draw(st.booleans()):
+        rows = []
+        for nest in spec.nests:
+            sched = ChunkSchedule(cfg.chunk_size, nest.trip, nest.start,
+                                  nest.step, cfg.thread_num)
+            rows.append(tuple(
+                draw(st.integers(0, cfg.thread_num - 1))
+                for _ in range(sched.n_chunks)
+            ) if draw(st.booleans()) else None)
+        asg = tuple(rows)
+    sp = None
+    if asg is None and draw(st.booleans()):
+        nest = spec.nests[0]
+        sp = nest.start + draw(st.integers(0, nest.trip - 1)) * nest.step
+    return spec, cfg, asg, sp
+
+
+@settings(max_examples=15, deadline=None)
+@given(args=schedules())
+def test_random_schedules_match_oracle(args):
+    spec, cfg, asg, sp = args
+    o = OracleSampler(spec, cfg).run(assignment=asg, start_point=sp)
+    _assert_result_matches(
+        run(spec, cfg, assignment=asg, start_point=sp), o, cfg)
+
+
 @settings(max_examples=10, deadline=None)
 @given(spec=specs(), cfg=configs())
 def test_random_specs_shard_matches_oracle(spec, cfg):
