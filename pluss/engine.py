@@ -50,7 +50,6 @@ from pluss.ops.reuse import (
     share_mask,
     share_unique,
     sort_stream,
-    window_events,
 )
 from pluss.sched import ChunkSchedule
 from pluss.spec import (
