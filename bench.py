@@ -193,6 +193,9 @@ def main() -> int:
     # driver's round-end run on this same box) warm-start in seconds
     import jax
 
+    from pluss.utils.platform import enable_x64
+
+    enable_x64()
     os.makedirs(".bench/jit_cache", exist_ok=True)
     jax.config.update("jax_compilation_cache_dir",
                       os.path.abspath(".bench/jit_cache"))

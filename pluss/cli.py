@@ -87,6 +87,9 @@ def banner_of(backend: str) -> str:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from pluss.utils.platform import enable_x64
+
+    enable_x64()
     p = argparse.ArgumentParser(prog="pluss", description=__doc__)
     p.add_argument("mode",
                    choices=("acc", "speed", "mrc", "trace", "sweep", "sample"))
@@ -103,8 +106,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="trace file format (packed LE uint64 | text)")
     p.add_argument("--model", default="gemm", choices=sorted(REGISTRY))
     p.add_argument("--n", type=int, default=128, help="problem size")
-    p.add_argument("--backends", default="vmap,shard,seq",
-                   help="comma list of " + ",".join(BACKENDS))
+    p.add_argument("--backends", default=None,
+                   help="comma list of " + ",".join(BACKENDS)
+                        + " (default: all three)")
     p.add_argument("--threads", type=int, default=4, help="simulated threads")
     p.add_argument("--chunk", type=int, default=4, help="schedule chunk size")
     p.add_argument("--reps", type=int, default=3, help="speed-mode repetitions")
@@ -141,7 +145,10 @@ def main(argv: list[str] | None = None) -> int:
 
     spec = REGISTRY[args.model](args.n)
     cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk)
-    backends = [b.strip() for b in args.backends.split(",") if b.strip()]
+    backends_explicit = args.backends is not None
+    backends = [b.strip()
+                for b in (args.backends or "vmap,shard,seq").split(",")
+                if b.strip()]
     for b in backends:
         if b not in BACKENDS:
             p.error(f"unknown backend {b!r}")
@@ -209,6 +216,15 @@ def main(argv: list[str] | None = None) -> int:
         # (which merely contains "shard") must not select it
         t0 = time.perf_counter()
         win = args.window or trace_mod.TRACE_WINDOW
+        if backends_explicit and backends != ["shard"]:
+            # an explicit backend choice other than exactly 'shard' is
+            # silently a no-op here — say so (mirrors the --window notice)
+            print(
+                f"pluss: trace mode ignores --backends {','.join(backends)}; "
+                "it streams on one device unless --backends is exactly "
+                "'shard' (device-sharded replay)",
+                file=sys.stderr,
+            )
         if backends == ["shard"]:
             rep = trace_mod.shard_replay(
                 trace_mod.load_trace(args.file, args.fmt), cls=cfg.cls,

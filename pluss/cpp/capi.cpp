@@ -110,7 +110,9 @@ int pluss_map_lines(const unsigned long long* raw, long long n, int shift,
   long long ok = 1;
   long long rebase = base - start;
   for (long long i = 0; i < n; ++i) {
-    long long line = static_cast<long long>(raw[i] >> shift);
+    // arithmetic shift on the SIGNED value: the Python mapper (trace.lines_of)
+    // shifts int64, so an address with bit 63 set must map identically here
+    long long line = static_cast<long long>(raw[i]) >> shift;
     long long off = line - start;
     ok &= static_cast<long long>(off >= 0) &
           static_cast<long long>(off < width);

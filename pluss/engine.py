@@ -407,7 +407,13 @@ def sort_window_bytes(np_: NestPlan, cfg: SamplerConfig, pos_dtype,
                       n_lines: int, refs=None) -> int:
     """Estimated device bytes to sort ONE window of ``refs`` (default: the
     nest's full ref set): sorted operands (key, pos, span, valid) plus
-    ghost entries, x4 for sort workspace."""
+    ghost entries, x4 for sort workspace.
+
+    Triangular nests use the static MAXIMUM trips (``fr.trips[1:]``) on
+    purpose: the enumeration shapes are static (bounded levels are padded
+    to their maximum and masked by validity), so the device buffers really
+    are that large in every window — an average-trip estimate would
+    understate the true allocation, not refine it."""
     refs = np_.refs if refs is None else refs
     entries = np_.window_rounds * cfg.chunk_size * sum(
         int(np.prod(fr.trips[1:], dtype=np.int64)) for fr in refs
@@ -562,7 +568,9 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                     f"windows (incl. sort workspace), beyond the "
                     f"{limit / 2**30:.2f} GiB device budget.  Use {remedy}, "
                     "or raise PLUSS_MAX_SORT_WINDOW_BYTES if the device "
-                    "can take it."
+                    "can take it.  (Bounded/triangular levels are sized at "
+                    "their static maximum because the enumeration shapes "
+                    "are static — the buffers really are this large.)"
                 )
     return StreamPlan(
         spec=spec,

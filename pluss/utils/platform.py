@@ -99,3 +99,19 @@ def probe_accelerator(timeout_s: float = 120.0) -> str | None:
         return None
     plat = out.stdout.strip()
     return plat if plat and plat != "cpu" else None
+
+
+def enable_x64() -> None:
+    """Turn on jax x64 (int64/float64 dtypes) for this process.
+
+    Streams beyond 2^31 accesses (e.g. GEMM-4096, >2^31-ref traces) need
+    int64 positions; without x64 ``engine.plan``/``pluss.trace`` raise
+    instead of running.  Device defaults are unaffected — every engine
+    array carries an explicit dtype.  A config update (not an env var)
+    because this image's sitecustomize imports JAX at interpreter startup,
+    after which ``JAX_ENABLE_X64`` is silently ignored.  Called by every
+    production entry point (cli, bench) and the test conftest.
+    """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)

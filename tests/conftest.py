@@ -1,17 +1,15 @@
-"""Test env: force an 8-device virtual CPU platform.
+"""Test env: force an 8-device virtual CPU platform, x64 on.
 
 Multi-chip hardware is unavailable in CI; sharding semantics are validated on a
-virtual 8-device CPU mesh exactly as SURVEY.md §7 prescribes.  The env vars are
-set before JAX initializes AND the config is re-forced afterwards because this
-image's sitecustomize registers a tunneled TPU backend that overrides
-``JAX_PLATFORMS`` at startup.  f64 stays enabled: the CRI/statistics pipeline
-matches C++ doubles (SURVEY.md §7 hard part 5).
+virtual 8-device CPU mesh exactly as SURVEY.md §7 prescribes.  The config is
+forced via ``jax.config.update`` (not env vars) because this image's
+sitecustomize imports JAX at interpreter startup — ``JAX_ENABLE_X64`` /
+``JAX_PLATFORMS`` set afterwards are silently ignored.  x64 on matches the
+production entry points (cli/bench, which need int64 positions for >2^31
+access streams); tests that need the x64-off behavior pin it off explicitly.
 """
 
-import os
-
-os.environ.setdefault("JAX_ENABLE_X64", "1")
-
-from pluss.utils.platform import force_cpu  # noqa: E402
+from pluss.utils.platform import enable_x64, force_cpu  # noqa: E402
 
 force_cpu(n_virtual_devices=8)
+enable_x64()

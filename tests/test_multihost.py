@@ -64,17 +64,36 @@ def test_two_process_shard_run_matches_engine(tmp_path):
     # workers log to FILES: draining two interdependent SPMD processes
     # through pipes sequentially can deadlock on a full pipe buffer
     logs = [tmp_path / f"worker{i}.log" for i in range(2)]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(portno), str(i), str(out)],
-            env=env, stdout=open(logs[i], "w"), stderr=subprocess.STDOUT,
-        )
-        for i in range(2)
-    ]
-    for p, lg in zip(procs, logs):
-        p.wait(timeout=600)
-        assert p.returncode == 0, \
-            f"worker failed:\n{lg.read_text()[-2000:]}"
+    handles: list = []
+    procs: list = []
+    try:
+        # spawn INSIDE the try: a failure launching worker 1 must still
+        # kill worker 0 and close its log handle
+        for i in range(2):
+            handles.append(open(logs[i], "w"))
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script), str(portno), str(i), str(out)],
+                env=env, stdout=handles[i], stderr=subprocess.STDOUT,
+            ))
+        for p, lg in zip(procs, logs):
+            p.wait(timeout=600)
+            assert p.returncode == 0, \
+                f"worker failed:\n{lg.read_text()[-2000:]}"
+    finally:
+        # a coordinator hang must not orphan the other jax.distributed
+        # worker past the test run; per-process errors must not mask the
+        # original failure or skip the remaining kills
+        try:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    try:
+                        p.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        pass
+        finally:
+            for h in handles:
+                h.close()
     got = json.load(open(out))
 
     from pluss.config import SamplerConfig
