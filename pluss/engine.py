@@ -134,6 +134,16 @@ class NestPlan:
     #: every window, alongside the template (which covers the other refs).
     #: Equal to ``refs`` when no template exists.
     var_refs: tuple[FlatRef, ...] = ()
+    #: interleave overlays (pluss.overlay): template-ineligible arrays whose
+    #: mixed-coefficient structure decomposes into per-group templates plus
+    #: closed-form collision corrections — O(lines) per ultra window instead
+    #: of the O(window) sort.  Verified against brute-force windows at plan
+    #: time; arrays that fail any check stay in the sort path.
+    overlays: tuple = ()
+    #: ``var_refs`` minus the overlaid arrays — what the vmap/seq ultra
+    #: window still sorts.  The shard backend and the non-ultra (sort-path)
+    #: windows keep using the full ``var_refs``/``refs``.
+    var_refs_novl: tuple[FlatRef, ...] = ()
     #: triangular nests only: [T, NW*W*CS] exclusive running access count at
     #: each stream slot (the thread's clock when the slot's parallel
     #: iteration starts); None for rectangular nests, whose positions are
@@ -442,7 +452,8 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
          window_accesses: int | None = None,
          n_windows: int | None = None,
          build_templates: bool = True,
-         sort_concurrency: int | None = None) -> StreamPlan:
+         sort_concurrency: int | None = None,
+         build_overlays: bool = True) -> StreamPlan:
     """Build the static stream plan.
 
     ``assignment``: optional per-nest chunk->thread maps (dynamic scheduling);
@@ -453,6 +464,10 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     ``build_templates``: False skips the host-side static-window template
     analysis — for callers that only ever take the sort path (the subset
     sampler's fresh-carry windows).
+    ``build_overlays``: False skips the interleave-overlay analysis AND its
+    brute-force verification — the shard backend passes False because its
+    ultra windows sort the full ``var_refs`` (overlays are a vmap/seq-only
+    optimization for now).
     """
     T = cfg.thread_num
     geom = []  # (sched, refs, body, asg, owned, W, NW) per nest
@@ -526,8 +541,48 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                 )
                 if tpl is not None:
                     var_refs = split_var
+        overlays: tuple = ()
+        var_novl = var_refs
+        # overlay build: only for clean (ultra) windows under the default
+        # static schedule with no resume skip — the closed forms assume
+        # cid = (w*W + r)*T + t.  Verification replays the algebra against
+        # brute windows, so a bad eligibility judgment degrades to the sort
+        # path instead of a wrong histogram.
+        if build_overlays and tpl is not None and var_refs and \
+                (start_point is None or ni != 0) and \
+                not os.environ.get("PLUSS_NO_OVERLAY"):
+            ultra = clean.all(axis=0)
+            n_pref = int(np.argmin(np.concatenate([ultra, [False]])))
+            if n_pref > 0:
+                from pluss.overlay import build_overlay, verify_overlay
+
+                by_arr: dict[str, list] = {}
+                for fr in var_refs:
+                    by_arr.setdefault(fr.ref.array, []).append(fr)
+                ovs = []
+                done: set[str] = set()
+                for arr, frs in by_arr.items():
+                    # w0 = 0: the gate above guarantees window 0 is ultra
+                    ov = build_overlay(arr, frs, cfg, sched, spec, W, 0,
+                                       body)
+                    if ov is None:
+                        continue
+                    # verification pairs stay inside the leading ultra
+                    # prefix (the brute replay walks windows 0..w) and the
+                    # real thread range (T may be 1)
+                    w_hi = min(n_pref - 1, 2)
+                    pairs = {(0, 0), (T - 1, min(1, w_hi)),
+                             (min(1, T - 1), w_hi)}
+                    if verify_overlay(ov, cfg, sched, NW, pairs):
+                        ovs.append(ov)
+                        done.add(arr)
+                if ovs:
+                    overlays = tuple(ovs)
+                    var_novl = tuple(fr for fr in var_refs
+                                     if fr.ref.array not in done)
         nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean,
-                              var_refs, clock))
+                              var_refs, overlays=overlays,
+                              var_refs_novl=var_novl, clock=clock))
         if not tri:  # triangular nests already counted via body_slot above
             for t in range(T):
                 for cid in owned[t]:
@@ -555,9 +610,12 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             streams.append(("sort", np_.refs,
                             "a static schedule (template path), a finer "
                             "chunk size"))
-        if np_.var_refs and np_.tpl is not None:
+        if np_.var_refs_novl and np_.tpl is not None:
+            # overlaid arrays are excluded: ultra windows process them in
+            # O(lines) with no sort at all (non-ultra windows are already
+            # covered by the full-refs "sort" stream check above)
             streams.append(("template's var (template-ineligible) part",
-                            np_.var_refs, "a finer chunk size"))
+                            np_.var_refs_novl, "a finer chunk size"))
         for label, refs_, remedy in streams:
             est = sort_window_bytes(np_, cfg, pos_dtype, n_lines,
                                     refs_) * conc
@@ -732,12 +790,17 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
         nb = nest_base[ni, tid]
         win_shift = np_.window_rounds * cfg.chunk_size * np_.body
         all_ranges = _array_ranges(np_.refs, pl.spec, cfg)
-        var_ranges = _array_ranges(np_.var_refs, pl.spec, cfg)
+        var_ranges = _array_ranges(np_.var_refs_novl, pl.spec, cfg)
         clock_row = None if np_.clock is None else jnp.asarray(np_.clock)[tid]
+        has_ovl = bool(np_.overlays)
+
+        def zero_minus(vdt):
+            return (jnp.zeros((share_cap,), vdt),
+                    jnp.zeros((share_cap,), jnp.int32), jnp.int32(0))
 
         def sort_step(carry, w, np_=np_, owned_row=owned_row, nb=nb,
                       win_shift=win_shift, all_ranges=all_ranges,
-                      clock_row=clock_row):
+                      clock_row=clock_row, has_ovl=has_ovl):
             last_pos, hist = carry
             last_pos, dh, ev, _ = _sort_window(
                 np_, np_.refs, all_ranges, cfg, owned_row, w, nb, bases,
@@ -745,7 +808,10 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                 clock_row=clock_row,
             )
             sv, sc, snu = share_unique(ev, share_cap)
-            return (last_pos, hist + dh), (sv, sc, snu)
+            ys = (sv, sc, snu)
+            if has_ovl:   # overlay nests also report share SUBTRACTIONS
+                ys = ys + zero_minus(sv.dtype)
+            return (last_pos, hist + dh), ys
 
         if np_.tpl is not None:
             tpl = np_.tpl
@@ -766,19 +832,31 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                            tpos=tpos, tdl=tdl, lhist=lhist, hs_idx=hs_idx,
                            units0=units0, shift_w=shift_w, nb=nb,
                            owned_row=owned_row, win_shift=win_shift,
-                           var_ranges=var_ranges):
+                           var_ranges=var_ranges, has_ovl=has_ovl):
                 last_pos, hist = carry
                 # template-ineligible arrays run the sort path inside the
                 # clean window too; disjoint line ranges make the two
                 # updates order-independent
                 ev_var = None
-                if np_.var_refs:
+                if np_.var_refs_novl:
                     last_pos, dh_var, ev_var, _ = _sort_window(
-                        np_, np_.var_refs, var_ranges, cfg, owned_row, w,
-                        nb, bases, pl.spec.array_index, pdt, last_pos,
+                        np_, np_.var_refs_novl, var_ranges, cfg, owned_row,
+                        w, nb, bases, pl.spec.array_index, pdt, last_pos,
                         win_shift,
                     )
                     hist = hist + dh_var
+                # interleave overlays: O(lines) exact window processing for
+                # the mixed-coefficient arrays (pluss.overlay)
+                ov_plus: list = []
+                ov_minus: list = []
+                for ov in np_.overlays:
+                    from pluss.overlay import device_window
+
+                    last_pos, dh_ov, plus, minus = device_window(
+                        ov, cfg, w, tid, nb, last_pos, pdt)
+                    hist = hist + dh_ov
+                    ov_plus.append((plus["reuse"], plus["share"]))
+                    ov_minus.append(minus)
                 units = (w - tpl.w0) * tpl.unit_w + units0
                 dpos = (w - tpl.w0).astype(pdt) * shift_w + nb
                 if tpl.head_runs is not None:
@@ -806,13 +884,15 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                         )
                 else:
                     last_pos = last_pos.at[tline + tdl * units].set(newv)
-                # share extraction over both sources: the template's
-                # share-capable head candidates + the var window's events
+                # share extraction over all sources: the template's
+                # share-capable head candidates + the var window's events +
+                # the overlays' added events
                 cand = []
                 if tpl.hs_idx.shape[0]:
                     cand.append((reuse[hs_idx], share[hs_idx]))
                 if ev_var is not None:
                     cand.append((ev_var["reuse"], ev_var["share"]))
+                cand.extend(ov_plus)
                 if cand:
                     sub = {
                         "reuse": jnp.concatenate([c[0] for c in cand]),
@@ -823,7 +903,16 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                     sv = jnp.zeros((share_cap,), reuse.dtype)
                     sc = jnp.zeros((share_cap,), jnp.int32)
                     snu = jnp.int32(0)
-                return (last_pos, hist), (sv, sc, snu)
+                ys = (sv, sc, snu)
+                if has_ovl:
+                    msub = {
+                        "reuse": jnp.concatenate(
+                            [m["reuse"] for m in ov_minus]),
+                        "share": jnp.concatenate(
+                            [m["share"] for m in ov_minus]),
+                    }
+                    ys = ys + share_unique(msub, share_cap)
+                return (last_pos, hist), ys
         else:
             ultra_step = None
 
@@ -873,27 +962,35 @@ def _thread_pipeline_packed(tid, pl: StreamPlan, share_cap: int):
     hist, share_ys = _thread_pipeline(tid, pl, share_cap)
     pdt = jnp.dtype(pl.pos_dtype)
     parts = [hist.astype(pdt).ravel()]
-    for sv, sc, snu in share_ys:
-        parts += [sv.astype(pdt).ravel(), sc.astype(pdt).ravel(),
-                  snu.astype(pdt).ravel()]
+    for ys in share_ys:   # 3 arrays per nest, or 6 with overlay subtractions
+        for a in ys:
+            parts.append(a.astype(pdt).ravel())
     return jnp.concatenate(parts)
 
 
 def _unpack(flat: np.ndarray, pl: StreamPlan, share_cap: int):
-    """Host-side inverse of :func:`_thread_pipeline_packed` over [T, L]."""
+    """Host-side inverse of :func:`_thread_pipeline_packed` over [T, L].
+
+    Per nest: (sv, sc, snu) share uniques, then the same triple again for
+    the overlay share SUBTRACTIONS when the nest has overlays.
+    """
     T = flat.shape[0]
     hist = flat[:, :NBINS]
     off = NBINS
     share_ys = []
     for n in pl.nests:
         NW = n.n_windows
-        sv = flat[:, off:off + NW * share_cap].reshape(T, NW, share_cap)
-        off += NW * share_cap
-        sc = flat[:, off:off + NW * share_cap].reshape(T, NW, share_cap)
-        off += NW * share_cap
-        snu = flat[:, off:off + NW].reshape(T, NW)
-        off += NW
-        share_ys.append((sv, sc, snu))
+        triples = 2 if n.overlays else 1
+        ys = []
+        for _ in range(triples):
+            sv = flat[:, off:off + NW * share_cap].reshape(T, NW, share_cap)
+            off += NW * share_cap
+            sc = flat[:, off:off + NW * share_cap].reshape(T, NW, share_cap)
+            off += NW * share_cap
+            snu = flat[:, off:off + NW].reshape(T, NW)
+            off += NW
+            ys += [sv, sc, snu]
+        share_ys.append(tuple(ys))
     assert off == flat.shape[1]
     return hist, share_ys
 
@@ -982,7 +1079,8 @@ def add_static_share(share_raw: list[dict],
 
 
 def merge_share_windows(svals, scnts, snu, share_cap: int,
-                        thread_num: int) -> list[dict]:
+                        thread_num: int, sign: int = 1,
+                        out: list[dict] | None = None) -> list[dict]:
     """Host-side merge of per-(thread, window) share uniques into raw dicts.
 
     Overflow detection is per *device-side* window: ``snu`` counts uniques
@@ -993,8 +1091,12 @@ def merge_share_windows(svals, scnts, snu, share_cap: int,
     That asymmetry is safe (static values are exact, not capped) but means
     a cap sized for the template path alone may still raise here when a
     ragged schedule sends a window down the sort path.
+
+    ``sign=-1`` with an existing ``out`` applies the overlay nests' share
+    SUBTRACTIONS (substituted template events that never happened).
     """
-    out: list[dict] = [dict() for _ in range(thread_num)]
+    if out is None:
+        out = [dict() for _ in range(thread_num)]
     for ni in range(len(svals)):
         sv = np.asarray(svals[ni])
         sc = np.asarray(scnts[ni])
@@ -1009,8 +1111,52 @@ def merge_share_windows(svals, scnts, snu, share_cap: int,
             nz = cnts > 0
             d = out[t]
             for v, c in zip(vals[nz].tolist(), cnts[nz].tolist()):
-                d[v] = d.get(v, 0) + c
+                d[v] = d.get(v, 0) + sign * c
     return out
+
+
+def overlay_static_share(share_raw: list[dict], pl: StreamPlan) -> None:
+    """Host-side static share accounting of the overlay nests.
+
+    Per ultra window, every thread's window contributes each overlaid
+    group's static in-window share events (shift-invariant, like the main
+    template's), MINUS the sweeping group's per-line static share on that
+    window's collision lines — those lines' S events were re-emitted
+    exactly by the device-side arrival corrections instead.
+    """
+    cfg = pl.cfg
+    T = cfg.thread_num
+    for np_ in pl.nests:
+        ultra = np.nonzero(np_.ultra_windows())[0]
+        if not len(ultra) or not np_.overlays:
+            continue
+        for ov in np_.overlays:
+            pairs = list(zip(ov.d_share_vals.tolist(),
+                             (ov.d_share_cnts * len(ultra)).tolist())) + \
+                list(zip(ov.s_share_vals.tolist(),
+                         (ov.s_share_cnts * len(ultra)).tolist()))
+            CSR = cfg.chunk_size * ov.R
+            for t in range(T):
+                d = share_raw[t]
+                for v, c in pairs:
+                    d[v] = d.get(v, 0) + c
+                # collision lines of every ultra window of this thread
+                lines = []
+                for w in ultra.tolist():
+                    for r in range(np_.window_rounds):
+                        rs = (((w * np_.window_rounds + r) * T + t)
+                              * cfg.chunk_size)
+                        lines.append(np.arange(rs * ov.R, rs * ov.R + CSR))
+                lines = np.concatenate(lines)
+                vals = ov.s_line_share_val[lines].ravel()
+                cnts = ov.s_line_share_cnt[lines].ravel()
+                nz = cnts > 0
+                uv, idx = np.unique(vals[nz], return_inverse=True)
+                uc = np.bincount(idx, weights=cnts[nz]).astype(np.int64)
+                # transiently-negative entries are fine mid-merge; run()
+                # sweeps zeros and asserts non-negativity at the end
+                for v, c in zip(uv.tolist(), uc.tolist()):
+                    d[v] = d.get(v, 0) - c
 
 
 def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
@@ -1030,15 +1176,31 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                      window_accesses, backend)
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
     hist, share_ys = _unpack(np.asarray(f(tids)), pl, share_cap)
-    # share_ys: per nest (svals [T, NW, cap], scnts, snu [T, NW])
+    # share_ys: per nest (svals [T, NW, cap], scnts, snu [T, NW]), plus the
+    # same triple of overlay SUBTRACTIONS for nests with overlays
     share_raw = merge_share_windows(
         [y[0] for y in share_ys], [y[1] for y in share_ys],
         [y[2] for y in share_ys], share_cap, cfg.thread_num,
     )
+    minus = [(ni, y) for ni, y in enumerate(share_ys) if len(y) > 3]
+    if minus:
+        merge_share_windows(
+            [y[3] for _, y in minus], [y[4] for _, y in minus],
+            [y[5] for _, y in minus], share_cap, cfg.thread_num,
+            sign=-1, out=share_raw,
+        )
     # static in-window share events of ultra windows are host-side constants:
     # identical values and counts for every clean window of every thread
     add_static_share(share_raw,
                      [(n, int(n.ultra_windows().sum())) for n in pl.nests])
+    if any(n.overlays for n in pl.nests):
+        overlay_static_share(share_raw, pl)
+        for t, d in enumerate(share_raw):
+            bad = {v: c for v, c in d.items() if c < 0}
+            assert not bad, \
+                f"overlay share accounting went negative (thread {t}): {bad}"
+            for v in [v for v, c in d.items() if c == 0]:
+                d.pop(v)
     return SamplerResult(
         noshare_dense=np.asarray(hist, np.int64),
         share_raw=share_raw,

@@ -1,0 +1,716 @@
+"""Interleave overlay: exact O(lines) windows for mixed-coefficient arrays.
+
+The static-window template (:class:`pluss.engine.WindowTemplate`) requires
+every ref of an array to share one parallel-dim address coefficient — arrays
+like syrk's ``A`` (``A0 = A[i][k]`` moving with the parallel loop, ``A1 =
+A[j][k]`` sweeping the whole array every iteration) fail that test and fall
+to the device sort path, which re-sorts the array's full access stream every
+window (~8.5e6 entries/window/thread for syrk-1024).  Round-2 established
+that hoisting a joint template for such arrays is impossible: the D/S
+interplay changes *structure* with the absolute parallel index
+(``engine._split_ref_groups``).
+
+This module exploits the complementary fact: each group ALONE is perfectly
+shift-invariant, and the groups only ever meet on the **collision lines** —
+the rows the moving group D touches in the current window (512 of 131072
+lines for syrk-1024).  On those rows the sweeping group S contributes only a
+sparse set of **arrivals** (~16e3 for syrk-1024), and because both line maps
+are affine and row-dense, every quantity the merge needs — D's predecessor /
+successor of an arrival, D's first/last access per line, S's previous/next
+arrival on a line — has a closed form.  So an ultra window costs:
+
+- S-template: per-line head resolution + static local histogram + tails over
+  the whole (static) line set, minus its precomputed per-line contributions
+  on the collision rows;
+- D-template: head/tail/static histogram on the collision rows;
+- arrival corrections: one event per arrival (against the max of its
+  D-predecessor, its own S-predecessor, and the carried table) plus a
+  substitution per broken D-gap — all vectorized, no sort at all.
+
+Exactness is not argued, it is **checked**: the correction algebra is written
+against a pluggable array module (``xp`` = numpy or jax.numpy), and
+:func:`verify_overlay` replays it in numpy against a brute-force lexsort of
+real windows at plan time; any mismatch disables the overlay for that array
+(the sort path remains the honest fallback).
+
+Replaces the behavior of the reference's hashmap walk on such workloads
+(``/root/reference/src/gemm_sampler.rs:123-133``) — capability parity with a
+~50x cut in device work per window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+
+import numpy as np
+
+from pluss.config import NBINS, SamplerConfig
+from pluss.ops.reuse import share_mask
+from pluss.spec import FlatRef
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlayPlan:
+    """Static geometry + tables of one overlaid array in one nest.
+
+    All line ids are ARRAY-LOCAL (0-based); ``line_base`` converts to the
+    engine's global line space.  Positions are thread-local stream clocks
+    WITHOUT the nest base (the device step adds ``nb``); they are
+    thread-invariant (every thread's window ``w`` spans the same rank range)
+    and shift by ``pos_shift`` per window.
+    """
+
+    array: str
+    line_base: int
+    n_lines: int
+    d_ref: FlatRef                # moving group (coef0 != 0), single ref
+    s_ref: FlatRef                # sweeping group (coef0 == 0), single ref
+    R: int                        # lines per parallel row
+    lpe: int                      # elements (inner-var steps) per line
+    J: int                        # D's free middle-loop trip
+    SL: int                       # window parallel slots = W * CS
+    W: int                        # window rounds
+    w0: int                       # template origin window (thread-invariant)
+    pos_shift: int                # window-to-window position shift
+    # D pos(g_rank, j, k) = g_rank*d_s0 + j*d_sj + k*d_sk + d_off
+    d_s0: int
+    d_sj: int
+    d_sk: int
+    d_off: int
+    # S pos(g_rank, u_idx, k) = g_rank*s_s0 + u_idx*s_su + k*s_sk + s_off
+    s_s0: int
+    s_su: int
+    s_sk: int
+    s_off: int
+    d_span: int                   # share span of D's ref (0 = never share)
+    s_span: int
+    d_local_hist: np.ndarray      # [NBINS] D's static in-window event hist
+    s_local_hist: np.ndarray      # [NBINS] S's static in-window event hist
+    d_share_vals: np.ndarray      # D static in-window share (value, count)
+    d_share_cnts: np.ndarray
+    s_share_vals: np.ndarray      # S static in-window share (value, count)
+    s_share_cnts: np.ndarray
+    #: [n_lines+1, NBINS] prefix sums of S's per-line static event hist
+    s_hist_prefix: np.ndarray
+    #: [n_lines, mtrip] per-line static share (value, count) pairs, 0-padded
+    s_line_share_val: np.ndarray
+    s_line_share_cnt: np.ndarray
+    #: [n_lines] S's first/last access position per line at window w0
+    s_first0: np.ndarray
+    s_last0: np.ndarray
+
+
+def _single_coef_levels(fr: FlatRef):
+    """Indices of loop levels with nonzero address coefficients."""
+    return [l for l, c in enumerate(fr.addr_coefs) if c]
+
+
+def _row_geometry(fr: FlatRef, lvl_u: int, cfg: SamplerConfig, sched):
+    """(row0, R, lpe) of a dense-row ref ``addr = base + c*u + k`` or None.
+
+    Requires: innermost coefficient 1 with start 0 / step 1, aligned rows
+    (``(base + c*u_start)*ds % cls == 0`` and ``c*u_step*ds % cls == 0``),
+    and exact density (``k_trip == c*u_step``: each row's inner range fills
+    the row exactly, so line = row0 + u_idx*R + k//lpe).
+    """
+    ds, cls = cfg.ds, cfg.cls
+    if cls % ds:
+        return None
+    lpe = cls // ds
+    kl = len(fr.trips) - 1
+    c = fr.addr_coefs[lvl_u]
+    if fr.addr_coefs[kl] != 1 or fr.starts[kl] != 0 or fr.steps[kl] != 1:
+        return None
+    if lvl_u == 0:
+        u_start, u_step, u_trip = sched.start, sched.step, sched.trip
+    else:
+        u_start, u_step, u_trip = fr.starts[lvl_u], fr.steps[lvl_u], \
+            fr.trips[lvl_u]
+    base = fr.ref.addr_base + c * u_start
+    if (base * ds) % cls or (c * u_step * ds) % cls:
+        return None
+    R = c * u_step * ds // cls
+    if R <= 0 or fr.trips[kl] != c * u_step:   # exact row density
+        return None
+    return (base * ds // cls, R, lpe, u_start, u_step, u_trip)
+
+
+def build_overlay(array: str, refs: list[FlatRef], cfg: SamplerConfig, sched,
+                  spec, W: int, w0: int, body: int) -> OverlayPlan | None:
+    """Overlay plan for one array's refs, or None if ineligible.
+
+    Eligibility (each check falls back to the sort path, never errors):
+    exactly one moving ref D (``addr = base + c*par + k``) and one sweeping
+    ref S (``addr = base + c*u + k`` over an inner loop u that mirrors the
+    parallel loop's range), both row-dense and aligned, sharing base/c/k
+    structure, with D's free loop coarser than a row (the closed-form
+    pred/succ digit condition).
+    """
+    if len(refs) != 2:
+        return None
+    movers = [fr for fr in refs if fr.addr_coefs[0]]
+    sweeps = [fr for fr in refs if not fr.addr_coefs[0]]
+    if len(movers) != 1 or len(sweeps) != 1:
+        return None
+    d, s = movers[0], sweeps[0]
+    kl_d, kl_s = len(d.trips) - 1, len(s.trips) - 1
+    if _single_coef_levels(d) != [0, kl_d] or kl_d < 2:
+        return None
+    lv_s = _single_coef_levels(s)
+    if len(lv_s) != 2 or lv_s[1] != kl_s or lv_s[0] == 0:
+        return None
+    if d.addr_coefs[0] != s.addr_coefs[lv_s[0]] or \
+            d.ref.addr_base != s.ref.addr_base:
+        return None
+    gd = _row_geometry(d, 0, cfg, sched)
+    gs = _row_geometry(s, lv_s[0], cfg, sched)
+    if gd is None or gs is None:
+        return None
+    row0_d, R, lpe, *_ = gd
+    row0_s, R_s, lpe_s, us, ust, utr = gs
+    # S's u loop must BE the parallel range (collision rows == u rows)
+    if (row0_d, R, lpe) != (row0_s, R_s, lpe_s) or \
+            (us, ust, utr) != (sched.start, sched.step, sched.trip):
+        return None
+    if row0_d != 0:
+        return None  # array-local line 0 at row 0 keeps slicing simple
+    ai = spec.array_index(array)
+    n_lines = spec.line_counts(cfg)[ai]
+    if sched.trip * R != n_lines or s.trips[lv_s[0]] != sched.trip:
+        return None  # S must cover the array's full contiguous line range
+    if kl_d != 2:   # chains deeper than (par, mid, inner) not yet handled
+        return None
+    J = d.trips[1]
+    d_sj = d.pos_strides[1]
+    d_sk = d.pos_strides[kl_d]
+    if d_sj <= (lpe - 1) * d_sk:      # digit condition for pred/succ
+        return None
+    if kl_s != 2 or lv_s[0] != 1:
+        return None
+    s_su = s.pos_strides[1]
+    s_sk = s.pos_strides[kl_s]
+    if s.pos_strides[0] <= (lpe - 1) * s_sk:   # arrival-lattice digits
+        return None
+    SL = W * cfg.chunk_size
+
+    # --- static tables from an origin-window numpy enumeration of S ------
+    line_s, pos_s = _np_ref_positions(s, W, w0, cfg, sched)
+    order = np.lexsort((pos_s, line_s))
+    line_s, pos_s = line_s[order], pos_s[order]
+    same = np.concatenate([[False], line_s[1:] == line_s[:-1]])
+    reuse = np.where(same, pos_s - np.concatenate([[0], pos_s[:-1]]), 0)
+    sh = same & share_mask(reuse, np.full(reuse.shape, s.ref.share_span or 0))
+    evt = same & ~sh
+    slots = np.frexp(reuse[evt].astype(np.float64))[1].astype(np.int64)
+    per_line = np.zeros((n_lines, NBINS), np.int64)
+    np.add.at(per_line, (line_s[evt], slots), 1)
+    s_hist_prefix = np.concatenate(
+        [np.zeros((1, NBINS), np.int64), np.cumsum(per_line, axis=0)])
+    # per-line share triplets, padded to the max count per line
+    lv = np.stack([line_s[sh], reuse[sh]], axis=1)
+    uniq, cnts = np.unique(lv, axis=0, return_counts=True)
+    mtrip = 1
+    if len(uniq):
+        mtrip = int(np.bincount(uniq[:, 0], minlength=n_lines).max())
+    lsv = np.zeros((n_lines, mtrip), np.int64)
+    lsc = np.zeros((n_lines, mtrip), np.int64)
+    fill = np.zeros(n_lines, np.int64)
+    for (ln, v), c in zip(uniq.tolist(), cnts.tolist()):
+        lsv[ln, fill[ln]] = v
+        lsc[ln, fill[ln]] = c
+        fill[ln] += 1
+    # S first/last position per line at w0 (line-sorted => segment ends)
+    head = ~same
+    tail = ~np.concatenate([line_s[1:] == line_s[:-1], [False]])
+    s_first0 = np.zeros(n_lines, np.int64)
+    s_last0 = np.zeros(n_lines, np.int64)
+    s_first0[line_s[head]] = pos_s[head]
+    s_last0[line_s[tail]] = pos_s[tail]
+    sv, sc = np.unique(reuse[sh], return_counts=True)
+
+    # D static hist/share from its own origin enumeration
+    line_d, pos_d = _np_ref_positions(d, W, w0, cfg, sched)
+    order = np.lexsort((pos_d, line_d))
+    line_d, pos_d = line_d[order], pos_d[order]
+    same = np.concatenate([[False], line_d[1:] == line_d[:-1]])
+    reuse = np.where(same, pos_d - np.concatenate([[0], pos_d[:-1]]), 0)
+    shd = same & share_mask(reuse, np.full(reuse.shape, d.ref.share_span or 0))
+    evtd = same & ~shd
+    slots = np.frexp(reuse[evtd].astype(np.float64))[1].astype(np.int64)
+    dv, dc = np.unique(reuse[shd], return_counts=True)
+
+    return OverlayPlan(
+        array=array,
+        line_base=spec.line_bases(cfg)[ai],
+        n_lines=n_lines,
+        d_ref=d,
+        s_ref=s,
+        R=R,
+        lpe=lpe,
+        J=J,
+        SL=SL,
+        W=W,
+        w0=w0,
+        pos_shift=W * cfg.chunk_size * body,
+        d_s0=d.pos_strides[0],
+        d_sj=d_sj,
+        d_sk=d_sk,
+        d_off=d.offset,
+        s_s0=s.pos_strides[0],
+        s_su=s_su,
+        s_sk=s_sk,
+        s_off=s.offset,
+        d_span=d.ref.share_span or 0,
+        s_span=s.ref.share_span or 0,
+        d_local_hist=np.bincount(slots, minlength=NBINS).astype(np.int64),
+        s_local_hist=s_hist_prefix[-1].copy(),
+        d_share_vals=dv.astype(np.int64),
+        d_share_cnts=dc.astype(np.int64),
+        s_share_vals=sv.astype(np.int64),
+        s_share_cnts=sc.astype(np.int64),
+        s_hist_prefix=s_hist_prefix,
+        s_line_share_val=lsv,
+        s_line_share_cnt=lsc,
+        s_first0=s_first0,
+        s_last0=s_last0,
+    )
+
+
+def _np_ref_positions(fr: FlatRef, W: int, w0: int, cfg: SamplerConfig,
+                      sched, t: int = 0):
+    """(array-local line, thread-local pos) of one ref over window ``w0`` of
+    thread ``t`` — numpy; feeds the static origin tables (t=0) AND the
+    brute-force verifier (any t).  Positions exclude the nest base
+    (thread-invariant by construction)."""
+    shape = (W, cfg.chunk_size) + fr.trips[1:]
+    nd = len(shape)
+
+    def iota(axis):
+        return np.arange(shape[axis], dtype=np.int64).reshape(
+            (1,) * axis + (-1,) + (1,) * (nd - axis - 1))
+
+    r, p = iota(0), iota(1)
+    cid = (w0 * W + r) * cfg.thread_num + t
+    g = cid * cfg.chunk_size + p
+    rank = (w0 * W + r) * cfg.chunk_size + p
+    pos = rank * fr.pos_strides[0] + fr.offset
+    addr = fr.ref.addr_base + fr.addr_coefs[0] * (sched.start + g * sched.step)
+    for l in range(1, len(fr.trips)):
+        idx = iota(l + 1)
+        pos = pos + idx * fr.pos_strides[l]
+        if fr.addr_coefs[l]:
+            addr = addr + fr.addr_coefs[l] * (fr.starts[l] + idx * fr.steps[l])
+    line = addr * cfg.ds // cfg.cls
+    line = np.broadcast_to(line, shape).reshape(-1)
+    pos = np.broadcast_to(pos, shape).reshape(-1)
+    return line, pos
+
+
+# --------------------------------------------------------------------------
+# The correction algebra — written once against ``xp`` (numpy | jax.numpy)
+# so the plan-time verifier replays EXACTLY the code the device runs.
+# --------------------------------------------------------------------------
+
+
+def window_geometry(ov: OverlayPlan, cfg: SamplerConfig, w, t, xp,
+                    dtype=np.int64):
+    """Per-window geometry: [W] collision row starts (array-local g index)
+    and the window's position shift relative to w0."""
+    r = xp.arange(ov.W, dtype=dtype)
+    row_start = (((w * ov.W + r) * cfg.thread_num + t) * cfg.chunk_size)
+    dpos = (w - ov.w0) * ov.pos_shift
+    return row_start, dpos
+
+
+def arrival_corrections(ov: OverlayPlan, cfg: SamplerConfig, w, t,
+                        carried_coll, xp, nb=0, dtype=np.int64):
+    """All per-arrival and per-collision-line corrections of one window.
+
+    ``carried_coll``: [W, CS*R] carried last positions of the collision
+    lines (array-local row blocks, pre-tail-write), positions ABSOLUTE
+    (i.e. including the nest base — all emitted positions are nest-local,
+    so the caller passes ``carried - nb`` and adds ``nb`` back to tails).
+
+    Returns a dict of flat arrays (static shapes):
+      add_reuse/add_cold/add_share/add_w : arrival + gap-substitution ADD
+        events (weight +1) — ``add_w`` 0 marks padding
+      sub_reuse/sub_cold/sub_share/sub_w : substitution SUB events
+      new_tail : [W, CS*R] true end-of-window tails of the collision lines
+      coll_rows : [W] first g-index of each collision row run
+
+    ``nb``: the thread's nest base — added to every computed position so
+    they compare directly against the engine's ABSOLUTE carried table
+    (cross-nest carries stay valid; -1 remains the only "untouched" value).
+    """
+    CS = cfg.chunk_size
+    R, lpe, J, SL, W = ov.R, ov.lpe, ov.J, ov.SL, ov.W
+    row_start, dpos = window_geometry(ov, cfg, w, t, xp, dtype)
+
+    # ---- arrival lattice: [slot s, row slot m, k] --------------------------
+    # slot s: the window's s-th parallel iteration (rank order); row slot m:
+    # which collision row the arrival lands on; k: S's inner index.
+    s_ = xp.arange(SL, dtype=dtype).reshape(SL, 1, 1)
+    m_ = xp.arange(SL, dtype=dtype).reshape(1, SL, 1)
+    k_ = xp.arange(ov.s_ref.trips[-1], dtype=dtype).reshape(1, 1, -1)
+    rank = ((w * W + s_ // CS) * CS + s_ % CS)
+    # u row of arrival = the m-th collision row (g index)
+    u_g = ((w * W + m_ // CS) * cfg.thread_num + t) * CS + m_ % CS
+    q = rank * ov.s_s0 + u_g * ov.s_su + k_ * ov.s_sk + ov.s_off + nb
+    L = u_g * R + k_ // lpe                       # array-local line
+    k0 = (L % R) * lpe                            # line's inner-octet start
+
+    # ---- D closed forms on the arrival's line ------------------------------
+    g_d = L // R                                  # D row == collision row
+    # D's rank for parallel index g: g = ((w*W + r)*T + t)*CS + p
+    rr = g_d // (cfg.thread_num * CS)             # global round of g
+    pp = g_d % CS
+    rank_d = rr * CS + pp
+    c_l = rank_d * ov.d_s0 + ov.d_off + nb
+    dfirst = c_l + k0 * ov.d_sk
+    dlast = c_l + (J - 1) * ov.d_sj + (k0 + lpe - 1) * ov.d_sk
+    qp = q - c_l
+    has_dpred = qp >= k0 * ov.d_sk
+    jq = xp.clip((qp - k0 * ov.d_sk) // ov.d_sj, 0, J - 1)
+    kq = xp.minimum(k0 + lpe - 1, (qp - jq * ov.d_sj) // ov.d_sk)
+    dpred = xp.where(has_dpred, c_l + jq * ov.d_sj + kq * ov.d_sk, -1)
+    # successor = lattice increment of the predecessor (positions unique)
+    k_wrap = kq >= k0 + lpe - 1
+    jn = xp.where(k_wrap, jq + 1, jq)
+    kn = xp.where(k_wrap, k0, kq + 1)
+    has_dsucc = xp.where(has_dpred, jn < J, True)
+    dsucc = xp.where(
+        has_dpred, c_l + jn * ov.d_sj + kn * ov.d_sk, dfirst)
+
+    # ---- arrival's own S neighbors (same line: fixed u, octet) -------------
+    in_oct = k_ % lpe                             # position within octet
+    has_aprev = (in_oct > 0) | (s_ > 0)
+    aprev = xp.where(
+        in_oct > 0, q - ov.s_sk,
+        q - ov.s_s0 + (lpe - 1) * ov.s_sk)        # (s-1, octet end)
+    aprev = xp.where(has_aprev, aprev, -1)
+    has_anext = (in_oct < lpe - 1) | (s_ < SL - 1)
+    anext = xp.where(
+        in_oct < lpe - 1, q + ov.s_sk,
+        q + ov.s_s0 - (lpe - 1) * ov.s_sk)
+    anext = xp.where(has_anext, anext, -1)
+
+    # ---- carried lookup ----------------------------------------------------
+    # collision lines are [W] runs of CS*R; arrival line -> (run, offset)
+    run = m_ // CS * xp.ones_like(L)
+    off = (m_ % CS) * R + k_ // lpe
+    carried = carried_coll[run, off + xp.zeros_like(L)]
+
+    # ---- per-arrival event: q vs max(dpred, aprev, carried) ---------------
+    pred = xp.maximum(xp.maximum(dpred, aprev), carried)
+    a_cold = pred < 0
+    a_reuse = xp.where(a_cold, 0, q - pred)
+    a_share = ~a_cold & share_mask(a_reuse, ov.s_span + xp.zeros_like(a_reuse))
+
+    # ---- gap substitution (once per broken D-gap: the gap's LAST arrival) --
+    last_in_gap = has_dsucc & (~has_anext | (anext > dsucc))
+    g_reuse = xp.where(last_in_gap, dsucc - q, 0)
+    g_share = last_in_gap & share_mask(
+        g_reuse, ov.d_span + xp.zeros_like(g_reuse))
+    # SUB the D event the gap used to carry (only when a D-pred exists;
+    # the no-dpred case substitutes D's HEAD event, handled per line)
+    sub_gap = last_in_gap & has_dpred
+    s_reuse = xp.where(sub_gap, dsucc - dpred, 0)
+    s_share = sub_gap & share_mask(
+        s_reuse, ov.d_span + xp.zeros_like(s_reuse))
+
+    # ---- per-collision-line corrections ------------------------------------
+    off_l = xp.arange(CS * R, dtype=dtype).reshape(1, CS * R)
+    g_l = row_start.reshape(W, 1) + off_l // R
+    rank_l = (g_l // (cfg.thread_num * CS)) * CS + g_l % CS
+    k0_l = (off_l % R) * lpe
+    c_ll = rank_l * ov.d_s0 + ov.d_off + nb
+    dfirst_l = c_ll + k0_l * ov.d_sk
+    dlast_l = c_ll + (J - 1) * ov.d_sj + (k0_l + lpe - 1) * ov.d_sk
+    # arrivals on line (m, k0): first at (slot 0, octet start), last at
+    # (slot SL-1, octet end)
+    rank0 = w * W * CS
+    rankz = (w * W + (SL - 1) // CS) * CS + (SL - 1) % CS
+    qfirst_l = rank0 * ov.s_s0 + g_l * ov.s_su + k0_l * ov.s_sk \
+        + ov.s_off + nb
+    qlast_l = rankz * ov.s_s0 + g_l * ov.s_su \
+        + (k0_l + lpe - 1) * ov.s_sk + ov.s_off + nb
+    new_tail = xp.maximum(dlast_l, qlast_l)
+    # D-template head events on every collision line (dfirst vs carried)
+    dh_cold = carried_coll < 0
+    dh_reuse = xp.where(dh_cold, 0, dfirst_l - carried_coll)
+    dh_share = ~dh_cold & share_mask(
+        dh_reuse, ov.d_span + xp.zeros_like(dh_reuse))
+    # D head substitution: when an arrival precedes D's first access, that
+    # head event never happened (the gap-substitution ADD above emitted
+    # D-first's true event against its preceding arrival instead)
+    head_broken = qfirst_l < dfirst_l
+    hb_cold = head_broken & dh_cold
+    hb_evt = head_broken & ~dh_cold
+    hb_reuse = xp.where(hb_evt, dh_reuse, 0)
+    hb_share = hb_evt & dh_share
+
+    flat = lambda a: xp.reshape(a, (-1,))
+    one = lambda a: xp.ones_like(a)
+    return {
+        "add_reuse": xp.concatenate(
+            [flat(a_reuse), flat(g_reuse), flat(dh_reuse)]),
+        "add_cold": xp.concatenate(
+            [flat(a_cold), flat(xp.zeros_like(g_reuse, bool)),
+             flat(dh_cold)]),
+        "add_share": xp.concatenate(
+            [flat(a_share), flat(g_share), flat(dh_share)]),
+        "add_w": xp.concatenate(
+            [flat(one(a_reuse)), flat(last_in_gap.astype(a_reuse.dtype)),
+             flat(one(dh_reuse))]),
+        "sub_reuse": xp.concatenate([flat(s_reuse), flat(hb_reuse)]),
+        "sub_cold": xp.concatenate([flat(xp.zeros_like(s_reuse, bool)),
+                                    flat(hb_cold)]),
+        "sub_share": xp.concatenate([flat(s_share), flat(hb_share)]),
+        "sub_w": xp.concatenate(
+            [flat(sub_gap.astype(s_reuse.dtype)),
+             flat((hb_evt | hb_cold).astype(s_reuse.dtype))]),
+        "new_tail": new_tail,
+        "coll_rows": row_start,
+        "dpos": dpos,
+    }
+
+
+def coll_mask_of(ov: OverlayPlan, cfg: SamplerConfig, w, t, xp,
+                 dtype=np.int64):
+    """[n_lines] True on this window's collision lines (array-local)."""
+    row_start, _ = window_geometry(ov, cfg, w, t, xp, dtype)
+    lines = xp.arange(ov.n_lines, dtype=dtype)
+    lo = row_start.reshape(-1, 1) * ov.R
+    hi = lo + cfg.chunk_size * ov.R
+    return ((lines.reshape(1, -1) >= lo) & (lines.reshape(1, -1) < hi)).any(0)
+
+
+def np_window_prediction(ov: OverlayPlan, cfg: SamplerConfig, w: int, t: int,
+                         carried: np.ndarray):
+    """Numpy replay of one overlay window: the EXACT algebra the device
+    runs, assembled into (hist[NBINS], share{val: cnt}, tails[n_lines]).
+
+    ``carried``: [n_lines] nest-local carried positions (-1 = untouched).
+    Used by :func:`verify_overlay`; the device twin lives in
+    ``pluss.engine`` (same correction functions, jnp arrays).
+    """
+    xp = np
+    CS, R = cfg.chunk_size, ov.R
+    hist = np.zeros(NBINS, np.int64)
+    share: dict[int, int] = {}
+
+    def bump(reuse, cold, shr, wgt):
+        reuse = np.asarray(reuse).ravel()
+        cold = np.asarray(cold).ravel()
+        shr = np.asarray(shr).ravel()
+        wgt = np.asarray(wgt).ravel().astype(np.int64)
+        evt = (wgt != 0) & ~cold & ~shr
+        slots = np.frexp(np.maximum(reuse, 1).astype(np.float64))[1]
+        np.add.at(hist, np.where(evt, slots, 0), np.where(evt, wgt, 0))
+        hist[0] += int((cold * wgt).sum())
+        for v, c in zip(reuse[shr & (wgt != 0)].tolist(),
+                        wgt[shr & (wgt != 0)].tolist()):
+            share[v] = share.get(v, 0) + c
+
+    row_start, dpos = window_geometry(ov, cfg, w, t, xp)
+    # carried slices of the collision runs
+    cc = np.stack([carried[rs * R: rs * R + CS * R] for rs in row_start])
+    cm = coll_mask_of(ov, cfg, w, t, xp)
+
+    # S-template heads on non-collision lines + static hists
+    sh = s_template_heads(ov, w, carried, cm, xp)
+    bump(sh["reuse"], sh["cold"], sh["share"],
+         sh["evt"] | sh["cold"] | sh["share"])
+    hist += ov.s_local_hist + ov.d_local_hist
+    for v, c in zip(ov.s_share_vals.tolist(), ov.s_share_cnts.tolist()):
+        share[v] = share.get(v, 0) + c
+    for v, c in zip(ov.d_share_vals.tolist(), ov.d_share_cnts.tolist()):
+        share[v] = share.get(v, 0) + c
+    # minus S's static per-line contributions on the collision lines
+    for rs in row_start:
+        lo, hi = rs * R, rs * R + CS * R
+        hist -= ov.s_hist_prefix[hi] - ov.s_hist_prefix[lo]
+        for ln in range(lo, hi):
+            for v, c in zip(ov.s_line_share_val[ln].tolist(),
+                            ov.s_line_share_cnt[ln].tolist()):
+                if c:
+                    share[v] = share.get(v, 0) - c
+
+    # arrival + D-head corrections
+    cor = arrival_corrections(ov, cfg, w, t, cc, xp)
+    bump(cor["add_reuse"], cor["add_cold"], cor["add_share"], cor["add_w"])
+    bump(cor["sub_reuse"], cor["sub_cold"], cor["sub_share"], -cor["sub_w"])
+
+    # tails: S writes everywhere, collision lines get max(Dlast, q_last)
+    tails = sh["tails"].copy()
+    for i, rs in enumerate(row_start):
+        tails[rs * R: rs * R + CS * R] = cor["new_tail"][i]
+    share = {v: c for v, c in share.items() if c}
+    return hist, share, tails
+
+
+def np_window_brute(ov: OverlayPlan, cfg: SamplerConfig, sched, w: int,
+                    t: int, carried: np.ndarray):
+    """Ground truth for one window of the overlaid array: enumerate both
+    refs for (thread t, window w), lexsort, and walk the merged per-line
+    streams against ``carried`` — the semantics of the engine's ghost-merged
+    sort window (ops.reuse.carried_events), in plain numpy."""
+    lines, poss, spans = [], [], []
+    for fr in (ov.d_ref, ov.s_ref):
+        line, pos = _np_ref_positions(fr, ov.W, w, cfg, sched, t)
+        lines.append(line)
+        poss.append(pos)
+        spans.append(np.full(line.shape, fr.ref.share_span or 0, np.int64))
+    line = np.concatenate(lines)
+    pos = np.concatenate(poss)
+    span = np.concatenate(spans)
+    order = np.lexsort((pos, line))
+    line, pos, span = line[order], pos[order], span[order]
+    same = np.concatenate([[False], line[1:] == line[:-1]])
+    prev = np.concatenate([[0], pos[:-1]])
+    head = ~same
+    carr = carried[line]
+    reuse = np.where(same, pos - prev, np.where(carr >= 0, pos - carr, 0))
+    cold = head & (carr < 0)
+    is_evt = same | (head & (carr >= 0))
+    shr = is_evt & share_mask(reuse, span)
+    evt = is_evt & ~shr
+    hist = np.zeros(NBINS, np.int64)
+    slots = np.frexp(np.maximum(reuse, 1).astype(np.float64))[1]
+    np.add.at(hist, slots[evt], 1)
+    hist[0] += int(cold.sum())
+    share: dict[int, int] = {}
+    for v in reuse[shr].tolist():
+        share[v] = share.get(v, 0) + 1
+    tails = carried.copy()
+    tail = ~np.concatenate([line[1:] == line[:-1], [False]])
+    tails[line[tail]] = pos[tail]
+    return hist, share, tails
+
+
+def verify_overlay(ov: OverlayPlan, cfg: SamplerConfig, sched,
+                   n_windows: int, pairs=None) -> bool:
+    """Replay the correction algebra (numpy) against brute-force windows.
+
+    Each (t, w) pair is checked with a REAL carried state: the brute walk
+    of windows 0..w-1 of that thread feeds window w, so carried-resolution,
+    cold, and substitution paths are all exercised.  Returns False on any
+    mismatch (callers then drop the overlay for this array).
+    """
+    T = cfg.thread_num
+    if pairs is None:
+        w_hi = min(n_windows - 1, 2)
+        pairs = {(0, 0), (T - 1, min(1, n_windows - 1)),
+                 (min(1, T - 1), w_hi)}
+    for t, w in sorted(pairs):
+        carried = np.full(ov.n_lines, -1, np.int64)
+        for wp in range(w):
+            *_, carried = np_window_brute(ov, cfg, sched, wp, t, carried)
+        bh, bs, bt = np_window_brute(ov, cfg, sched, w, t, carried)
+        ph, ps, pt = np_window_prediction(ov, cfg, w, t, carried)
+        if not ((bh == ph).all() and bs == ps and (bt == pt).all()):
+            print(f"pluss.overlay: verification FAILED for array "
+                  f"{ov.array!r} at (t={t}, w={w}); using the sort path",
+                  file=sys.stderr)
+            return False
+    return True
+
+
+def device_window(ov: OverlayPlan, cfg: SamplerConfig, w, t, nb, last_pos,
+                  pdt):
+    """One overlay window on device (jnp twin of the numpy predictor).
+
+    ``w``/``t`` are traced scalars (scan window index, vmapped thread id);
+    ``nb`` the thread's nest base; ``last_pos`` the GLOBAL carried table.
+    Returns ``(last_pos, hist_delta, plus_ev, minus_ev)`` — the ev dicts
+    feed :func:`pluss.ops.reuse.share_unique` (plus) and the subtraction
+    pass (minus) with ``{"reuse", "share"}`` arrays.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pluss.ops.reuse import bin_histogram, log2_bin
+
+    dt = jnp.dtype(pdt)
+    R, W, CS = ov.R, ov.W, cfg.chunk_size
+    CSR = CS * R
+    base = ov.line_base
+    w = w.astype(dt)
+    t = t.astype(dt)
+    row_start, _ = window_geometry(ov, cfg, w, t, jnp, dt)
+    # carried state BEFORE any tail write: collision runs + the whole array
+    cc = jnp.stack([
+        jax.lax.dynamic_slice(last_pos, (base + row_start[i] * R,), (CSR,))
+        for i in range(W)
+    ])
+    carried_all = jax.lax.slice(last_pos, (base,), (base + ov.n_lines,))
+    cm = coll_mask_of(ov, cfg, w, t, jnp, dt)
+    sh = s_template_heads(
+        ov, w, carried_all, cm, jnp, nb=nb,
+        first0=jnp.asarray(ov.s_first0.astype(pdt)),
+        last0=jnp.asarray(ov.s_last0.astype(pdt)))
+    cor = arrival_corrections(ov, cfg, w, t, cc, jnp, nb=nb, dtype=dt)
+
+    # static histograms, minus S's per-line share on the collision runs
+    hist = jnp.asarray((ov.s_local_hist + ov.d_local_hist).astype(pdt))
+    pre = jnp.asarray(ov.s_hist_prefix.astype(pdt))
+    z = jnp.int32(0)
+    for i in range(W):
+        lo = (row_start[i] * R).astype(jnp.int32)
+        top = jax.lax.dynamic_slice(pre, (lo + CSR, z), (1, NBINS))[0]
+        bot = jax.lax.dynamic_slice(pre, (lo, z), (1, NBINS))[0]
+        hist = hist - (top - bot)
+
+    def bump(hist, reuse, cold, share, wgt):
+        evt = (wgt != 0) & ~cold & ~share
+        bins = jnp.where(evt, log2_bin(jnp.maximum(reuse, 1)), 0)
+        wb = jnp.where(evt | cold, wgt, 0).astype(pdt)
+        return hist + bin_histogram(bins, wb)
+
+    one = (sh["evt"] | sh["cold"]).astype(dt)
+    hist = bump(hist, sh["reuse"], sh["cold"], sh["share"], one)
+    hist = bump(hist, cor["add_reuse"], cor["add_cold"], cor["add_share"],
+                cor["add_w"])
+    hist = bump(hist, cor["sub_reuse"], cor["sub_cold"], cor["sub_share"],
+                -cor["sub_w"])
+
+    # tails: S template everywhere, then max(D-last, last-arrival) on the
+    # collision runs
+    upd = sh["tails"].astype(pdt)
+    for i in range(W):
+        upd = jax.lax.dynamic_update_slice(
+            upd, cor["new_tail"][i].astype(pdt), (row_start[i] * R,))
+    last_pos = jax.lax.dynamic_update_slice(last_pos, upd, (base,))
+
+    plus = {
+        "reuse": jnp.concatenate([cor["add_reuse"], sh["reuse"]]),
+        "share": jnp.concatenate(
+            [cor["add_share"] & (cor["add_w"] != 0), sh["share"]]),
+    }
+    minus = {
+        "reuse": cor["sub_reuse"],
+        "share": cor["sub_share"] & (cor["sub_w"] != 0),
+    }
+    return last_pos, hist, plus, minus
+
+
+def s_template_heads(ov: OverlayPlan, w, carried_all, coll_mask, xp, nb=0,
+                     first0=None, last0=None):
+    """S-template per-line head events on NON-collision lines.
+
+    ``carried_all``: [n_lines] ABSOLUTE carried positions of the whole
+    array; ``coll_mask``: [n_lines] True on collision lines (suppressed —
+    their S accesses are handled as arrivals).  ``first0``/``last0`` let the
+    device pass pre-converted (dtype, device-resident) copies of the static
+    tables."""
+    dpos = (w - ov.w0) * ov.pos_shift + nb
+    first = (xp.asarray(ov.s_first0) if first0 is None else first0) + dpos
+    act = ~coll_mask
+    cold = act & (carried_all < 0)
+    evt = act & (carried_all >= 0)
+    reuse = xp.where(evt, first - carried_all, 0)
+    share = evt & share_mask(reuse, ov.s_span + xp.zeros_like(reuse))
+    return {"reuse": reuse, "cold": cold, "evt": evt, "share": share,
+            "tails": (xp.asarray(ov.s_last0) if last0 is None else last0)
+            + dpos}

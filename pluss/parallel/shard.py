@@ -303,7 +303,11 @@ def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
     # same constant as the single-device scan regardless of workload size
     S = max(1, -(-natural_n_windows(spec, cfg, assignment, start_point,
                                     window_accesses) // D))
-    pl = plan(spec, cfg, assignment, start_point, n_windows=D * S)
+    # overlays off: the shard ultra window sorts the full var_refs, so the
+    # budget guard must size that stream (and the overlay verification cost
+    # would be pure waste here)
+    pl = plan(spec, cfg, assignment, start_point, n_windows=D * S,
+              build_overlays=False)
     f = jax.shard_map(
         lambda t: _shard_body(t, pl, share_cap, D, S),
         mesh=mesh,

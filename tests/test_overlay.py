@@ -1,0 +1,105 @@
+"""Interleave overlay (pluss.overlay): eligibility, exactness, fallback.
+
+The overlay replaces the device sort for mixed-coefficient arrays (syrk's
+A[i][k] / A[j][k] pair) with per-group templates + closed-form collision
+corrections.  These tests pin: (a) the overlay actually engages for syrk,
+(b) engine results are bit-identical with it on and off, (c) the plan-time
+brute-force verifier catches a corrupted algebra, (d) ineligible shapes
+fall back silently.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from pluss import engine
+from pluss.config import SamplerConfig
+from pluss.models import syrk
+from pluss.sched import ChunkSchedule
+from pluss.spec import flatten_nest, nest_iteration_size
+from pluss import overlay as ovm
+from tests.oracle import OracleSampler
+
+
+def _overlay_arrays(pl):
+    return [ov.array for np_ in pl.nests for ov in np_.overlays]
+
+
+def _build(n, cfg, W=1):
+    spec = syrk(n)
+    nest = spec.nests[0]
+    sched = ChunkSchedule(cfg.chunk_size, nest.trip, nest.start, nest.step,
+                          cfg.thread_num)
+    refs = [fr for fr in flatten_nest(nest) if fr.ref.array == "A"]
+    ov = ovm.build_overlay("A", refs, cfg, sched, spec, W, 0,
+                           nest_iteration_size(nest))
+    return ov, sched
+
+
+def test_overlay_engages_for_syrk():
+    pl = engine.plan(syrk(32), SamplerConfig())
+    assert _overlay_arrays(pl) == ["A"]
+    # the overlaid array leaves the in-ultra sort stream entirely
+    assert pl.nests[0].var_refs_novl == ()
+    # ... but stays in var_refs for the shard backend and sort windows
+    assert {fr.ref.array for fr in pl.nests[0].var_refs} == {"A"}
+
+
+def test_overlay_off_matches_overlay_on(monkeypatch):
+    spec, cfg = syrk(32), SamplerConfig()
+    on = engine.run(spec, cfg)
+    engine.compiled.cache_clear()
+    monkeypatch.setenv("PLUSS_NO_OVERLAY", "1")
+    assert _overlay_arrays(engine.plan(spec, cfg)) == []
+    off = engine.run(spec, cfg)
+    engine.compiled.cache_clear()  # don't leak the no-overlay executable
+    assert np.array_equal(on.noshare_dense, off.noshare_dense)
+    assert on.share_raw == off.share_raw
+    assert on.max_iteration_count == off.max_iteration_count
+
+
+def test_overlay_matches_oracle_seq_backend():
+    spec, cfg = syrk(32), SamplerConfig()
+    r = engine.run(spec, cfg, backend="seq")
+    o = OracleSampler(spec, cfg).run()
+    assert r.max_iteration_count == o.max_iteration_count
+    for t in range(cfg.thread_num):
+        assert r.noshare_dict(t) == o.noshare[t]
+        assert r.share_dict(t) == \
+            {k: dict(v) for k, v in o.share[t].items() if v}
+
+
+@pytest.mark.parametrize("n,cfg,W", [
+    (16, SamplerConfig(cls=8), 1),
+    (32, SamplerConfig(), 2),
+    (24, SamplerConfig(thread_num=3, chunk_size=2), 2),
+    (64, SamplerConfig(thread_num=8, chunk_size=1), 1),
+])
+def test_verifier_exhaustive(n, cfg, W):
+    import itertools
+
+    ov, sched = _build(n, cfg, W)
+    assert ov is not None
+    rounds = -(-sched.n_chunks // cfg.thread_num)
+    NW = rounds // W
+    assert NW * W == rounds
+    pairs = set(itertools.product(range(cfg.thread_num), range(NW)))
+    assert ovm.verify_overlay(ov, cfg, sched, NW, pairs)
+
+
+def test_verifier_catches_corruption(capsys):
+    ov, sched = _build(32, SamplerConfig(), 1)
+    bad = dataclasses.replace(ov, d_off=ov.d_off + 1)  # shift D's clock
+    assert not ovm.verify_overlay(bad, SamplerConfig(), sched, 1, {(0, 0)})
+    assert "verification FAILED" in capsys.readouterr().err
+
+
+def test_ineligible_shapes_fall_back():
+    # fractional row shift: 20 elements/row * 8 B = 160 B, not a multiple
+    # of the 64 B line — overlay must decline, engine must still be exact
+    cfg = SamplerConfig()
+    ov, _ = _build(20, cfg, 1)
+    assert ov is None
+    pl = engine.plan(syrk(20), cfg)
+    assert _overlay_arrays(pl) == []
