@@ -95,6 +95,14 @@ def main(argv: list[str] | None = None) -> int:
                    choices=("acc", "speed", "mrc", "trace", "sweep", "sample"))
     p.add_argument("--rates", default="0.05,0.1,0.25,0.5,1.0",
                    help="sample-mode sampling rates (comma list)")
+    p.add_argument("--sample-mode", default="uniform",
+                   choices=("uniform", "prefix"),
+                   help="sample-mode estimator: uniform random windows with "
+                        "warm-up context, or the prefix (warm-up-then-"
+                        "measure) chain")
+    p.add_argument("--context", type=int, default=None,
+                   help="sample-mode warm-up context windows (default: "
+                        "auto-sized to the largest share span)")
     p.add_argument("--sweep-threads", default="1,2,4,8",
                    help="sweep-mode thread counts (comma list)")
     p.add_argument("--sweep-chunks", default="1,4,16",
@@ -184,8 +192,11 @@ def main(argv: list[str] | None = None) -> int:
         from pluss import sampling
 
         rates = [float(x) for x in args.rates.split(",") if x]
-        tbl = sampling.mrc_error_table(spec, cfg, rates, share_cap=args.share_cap,
-                                       window_accesses=args.window)
+        tbl = sampling.mrc_error_table(spec, cfg, rates,
+                                       share_cap=args.share_cap,
+                                       window_accesses=args.window,
+                                       context_windows=args.context,
+                                       mode=args.sample_mode)
         out.write(f"{spec.name}: sampled-MRC L2 error vs full enumeration\n")
         out.write("rate,walked_fraction,l2_error\n")
         for rate, frac, err in tbl:

@@ -70,10 +70,80 @@ WINDOW_TARGET = 1 << 23
 #: windows fall back to the device sort path.  2^29 admits GEMM-4096, whose
 #: single chunk-round (268M accesses — windows never split a round) would
 #: OOM the device as one sort window but collapses to O(lines) under the
-#: template; the host lexsort is minutes once per (spec, cfg), cached.
+#: template; the host lexsort is minutes once per (spec, cfg), cached
+#: on disk (see :func:`_plan_cache_get`).
 #: Ragged schedules beyond this size (no template possible) remain limited
 #: by device sort memory — a known bound of the round-window granularity.
 MAX_TEMPLATE_WINDOW = 1 << 29
+
+
+@functools.lru_cache(maxsize=1)
+def _plan_cache_salt() -> str:
+    """Content hash of the plan-analysis sources: ANY edit to the template
+    or overlay logic invalidates every cached artifact automatically."""
+    import hashlib
+
+    h = hashlib.sha256()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in ("engine.py", "overlay.py", "spec.py", "sched.py",
+                 "config.py", os.path.join("ops", "reuse.py")):
+        with open(os.path.join(here, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _plan_cache_path(key: str) -> str | None:
+    """Disk slot for one nest's plan artifacts, or None when caching is off.
+
+    The cache holds host-side analysis products only (WindowTemplate +
+    verified OverlayPlans) — expensive to build (GEMM-4096's template
+    lexsort is minutes; overlay verification is seconds-to-tens), cheap to
+    load.  Directory: $PLUSS_PLAN_CACHE_DIR, else ``.bench/plan_cache`` if
+    ``.bench`` exists in the CWD (the bench/driver layout); else disabled.
+    ``PLUSS_NO_PLAN_CACHE=1`` disables (the test suite sets it so template
+    bugs can never hide behind a stale artifact)."""
+    if os.environ.get("PLUSS_NO_PLAN_CACHE"):
+        return None
+    root = os.environ.get("PLUSS_PLAN_CACHE_DIR")
+    if root is None:
+        if not os.path.isdir(".bench"):
+            return None
+        root = os.path.join(".bench", "plan_cache")
+    os.makedirs(root, exist_ok=True)
+    return os.path.join(root, key + ".pkl")
+
+
+def _plan_cache_key(spec, cfg, ni: int, W: int, NW: int) -> str:
+    import hashlib
+
+    return hashlib.sha256(
+        repr((_plan_cache_salt(), spec, cfg, ni, W, NW)).encode()
+    ).hexdigest()[:32]
+
+
+def _plan_cache_get(key: str):
+    path = _plan_cache_path(key)
+    if path is None or not os.path.exists(path):
+        return None
+    import pickle
+
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception:
+        return None  # corrupt/partial cache entry: rebuild
+
+
+def _plan_cache_put(key: str, value) -> None:
+    path = _plan_cache_path(key)
+    if path is None:
+        return
+    import pickle
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(value, f)
+    os.replace(tmp, path)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -529,16 +599,24 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         # shift-invariance the template rests on; both gates are keyed on
         # the nest TREE, not on net-slope arithmetic — canceling sibling
         # slopes and fixed-trip varying starts would slip through otherwise
+        cache_key = None
+        cached = None
         if build_templates and asg is None and not tri and \
                 not nest_has_varying_start(spec.nests[ni]) and \
                 W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW:
             tpl_refs, split_var = _split_ref_groups(refs, sched, cfg)
             if tpl_refs:
                 clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
-                tpl = _build_template(
-                    tpl_refs, W, cfg, sched, owned, clean,
-                    spec.line_bases(cfg), spec.array_index, body,
-                )
+                cache_key = _plan_cache_key(
+                    spec, cfg, ni, W, NW) if start_point is None else None
+                cached = _plan_cache_get(cache_key) if cache_key else None
+                if cached is not None:
+                    tpl = cached["tpl"]
+                else:
+                    tpl = _build_template(
+                        tpl_refs, W, cfg, sched, owned, clean,
+                        spec.line_bases(cfg), spec.array_index, body,
+                    )
                 if tpl is not None:
                     var_refs = split_var
         overlays: tuple = ()
@@ -551,35 +629,49 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         if build_overlays and tpl is not None and var_refs and \
                 (start_point is None or ni != 0) and \
                 not os.environ.get("PLUSS_NO_OVERLAY"):
-            ultra = clean.all(axis=0)
-            n_pref = int(np.argmin(np.concatenate([ultra, [False]])))
-            if n_pref > 0:
-                from pluss.overlay import build_overlay, verify_overlay
+            if cached is not None and cached.get("overlays") is not None:
+                overlays = cached["overlays"]
+                done = {ov.array for ov in overlays}
+                var_novl = tuple(fr for fr in var_refs
+                                 if fr.ref.array not in done)
+            else:
+                ultra = clean.all(axis=0)
+                n_pref = int(np.argmin(np.concatenate([ultra, [False]])))
+                if n_pref > 0:
+                    from pluss.overlay import build_overlay, verify_overlay
 
-                by_arr: dict[str, list] = {}
-                for fr in var_refs:
-                    by_arr.setdefault(fr.ref.array, []).append(fr)
-                ovs = []
-                done: set[str] = set()
-                for arr, frs in by_arr.items():
-                    # w0 = 0: the gate above guarantees window 0 is ultra
-                    ov = build_overlay(arr, frs, cfg, sched, spec, W, 0,
-                                       body)
-                    if ov is None:
-                        continue
-                    # verification pairs stay inside the leading ultra
-                    # prefix (the brute replay walks windows 0..w) and the
-                    # real thread range (T may be 1)
-                    w_hi = min(n_pref - 1, 2)
-                    pairs = {(0, 0), (T - 1, min(1, w_hi)),
-                             (min(1, T - 1), w_hi)}
-                    if verify_overlay(ov, cfg, sched, NW, pairs):
-                        ovs.append(ov)
-                        done.add(arr)
-                if ovs:
-                    overlays = tuple(ovs)
-                    var_novl = tuple(fr for fr in var_refs
-                                     if fr.ref.array not in done)
+                    by_arr: dict[str, list] = {}
+                    for fr in var_refs:
+                        by_arr.setdefault(fr.ref.array, []).append(fr)
+                    ovs = []
+                    done = set()
+                    for arr, frs in by_arr.items():
+                        # w0 = 0: the gate above guarantees window 0 is ultra
+                        ov = build_overlay(arr, frs, cfg, sched, spec, W, 0,
+                                           body)
+                        if ov is None:
+                            continue
+                        # verification pairs stay inside the leading ultra
+                        # prefix (the brute replay walks windows 0..w) and
+                        # the real thread range (T may be 1)
+                        w_hi = min(n_pref - 1, 2)
+                        pairs = {(0, 0), (T - 1, min(1, w_hi)),
+                                 (min(1, T - 1), w_hi)}
+                        if verify_overlay(ov, cfg, sched, NW, pairs):
+                            ovs.append(ov)
+                            done.add(arr)
+                    if ovs:
+                        overlays = tuple(ovs)
+                        var_novl = tuple(fr for fr in var_refs
+                                         if fr.ref.array not in done)
+                if cache_key and (cached is None
+                                  or cached.get("overlays") is None):
+                    _plan_cache_put(cache_key,
+                                    {"tpl": tpl, "overlays": overlays})
+        elif cache_key and cached is None and tpl is not None:
+            # cache the template even when overlays are skipped (shard
+            # backend, resume runs build their own keyless plans)
+            _plan_cache_put(cache_key, {"tpl": tpl, "overlays": None})
         nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean,
                               var_refs, overlays=overlays,
                               var_refs_novl=var_novl, clock=clock))
@@ -1197,8 +1289,10 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         overlay_static_share(share_raw, pl)
         for t, d in enumerate(share_raw):
             bad = {v: c for v, c in d.items() if c < 0}
-            assert not bad, \
-                f"overlay share accounting went negative (thread {t}): {bad}"
+            if bad:  # a real error, not an assert: must survive python -O
+                raise RuntimeError(
+                    f"overlay share accounting went negative (thread {t}): "
+                    f"{bad}")
             for v in [v for v, c in d.items() if c == 0]:
                 d.pop(v)
     return SamplerResult(

@@ -17,14 +17,21 @@ the full engine) from an empty LAT table, in parallel — samples are
 independent, so the whole estimate is one ``vmap`` over (thread, window) with
 no carry, the embarrassingly-parallel shape the full scan cannot have.
 
-Semantics of a sampled window match a reference run restricted to it: reuses
-inside the window are exact; accesses whose predecessor lies OUTSIDE the
-window are censored and counted as cold, exactly like the reference's
-end-of-run flush (``gemm_sampler.rs:48-53``) at the window boundary.
-Histogram counts scale by ``NW / n_sampled``.  The bias (boundary cold
-instead of long carried reuses) shrinks as the window span grows —
-``window_accesses`` IS the K-chunk span knob.  At ``NW == 1`` the estimate
-degenerates to the exact full enumeration.
+Semantics of a sampled window match a reference run restricted to it plus
+its **context**: before the counted walk, ``context_windows`` preceding
+windows are walked UNCOUNTED — only their tail tables survive — so accesses
+whose predecessor lies within the context span resolve to their true reuse
+instead of censoring to cold.  This is precisely the reference's declared
+``setStartPoint`` + ``getPrevKChunksFrom`` pattern
+(``pluss_utils.h:443-587``): K chunks of warm-up context before a sampled
+start point.  Only predecessors beyond the context still censor (counted as
+cold, like the reference's end-of-run flush, ``gemm_sampler.rs:48-53``).
+The default context is auto-sized so the context+window span covers the
+nest's largest share span — the dominant carried-reuse length.
+
+Histogram counts scale by ``NW / n_sampled``; ``sampled_fraction`` counts
+BOTH the counted windows and their context walks (the honest cost).  At
+``NW == 1`` the estimate degenerates to the exact full enumeration.
 """
 
 from __future__ import annotations
@@ -62,8 +69,14 @@ def _plan_cached(spec: LoopNestSpec, cfg: SamplerConfig,
 
 @functools.lru_cache(maxsize=64)
 def _window_fn(spec: LoopNestSpec, cfg: SamplerConfig, ni: int,
-               share_cap: int, window_accesses: int | None):
-    """jit[(T,), (nsel,)] -> per-(thread, window) fresh-carry walk results."""
+               share_cap: int, window_accesses: int | None, warm_k: int):
+    """jit[(T,), (nsel,)] -> per-(thread, window) context-warmed walk results.
+
+    ``warm_k`` preceding windows are walked tails-only first (the
+    reference's ``getPrevKChunksFrom`` warm-up, ``pluss_utils.h:554-587``);
+    window indices below 0 clamp to 0 and their (idempotent or irrelevant)
+    tail writes are masked out, so the whole warm-up stays branch-free.
+    """
     pl = _plan_cached(spec, cfg, window_accesses)
     np_ = pl.nests[ni]
     bases = pl.spec.line_bases(cfg)
@@ -76,10 +89,22 @@ def _window_fn(spec: LoopNestSpec, cfg: SamplerConfig, ni: int,
     def one(t, w):
         last_pos = jnp.full((n_lines,), -1, pdt)
         clock_row = None if np_.clock is None else jnp.asarray(np_.clock)[t]
+        owned_row = jnp.asarray(np_.owned)[t]
+        nb = nest_base[ni, t]
+        for j in range(warm_k):
+            wc = jnp.maximum(w - warm_k + j, 0)
+            lp2, _, _, _ = _sort_window(
+                np_, np_.refs, ranges, cfg, owned_row, wc, nb, bases,
+                pl.spec.array_index, pdt, last_pos, win_shift,
+                with_hist=False, clock_row=clock_row,
+            )
+            # apply the context's tails only when it precedes the sampled
+            # window (w < warm_k has fewer real context windows)
+            last_pos = jnp.where(wc < w, lp2, last_pos)
         _, dh, ev, _ = _sort_window(
-            np_, np_.refs, ranges, cfg, jnp.asarray(np_.owned)[t], w,
-            nest_base[ni, t], bases, pl.spec.array_index, pdt, last_pos,
-            win_shift, clock_row=clock_row,
+            np_, np_.refs, ranges, cfg, owned_row, w, nb, bases,
+            pl.spec.array_index, pdt, last_pos, win_shift,
+            clock_row=clock_row,
         )
         sv, sc, snu = share_unique(ev, share_cap)
         return dh, sv, sc, snu
@@ -89,36 +114,154 @@ def _window_fn(spec: LoopNestSpec, cfg: SamplerConfig, ni: int,
     return pl, fn
 
 
+def _auto_context(np_, cfg: SamplerConfig) -> int:
+    """Context windows needed so context+window span covers the nest's
+    largest share span (the dominant carried-reuse length); at least 1 so
+    ordinary cross-window reuses resolve too."""
+    span = max((fr.ref.share_span or 0 for fr in np_.refs), default=0)
+    win_span = np_.window_rounds * cfg.chunk_size * np_.body
+    k = max(1, -(-span // win_span)) if win_span else 1
+    return min(k, np_.n_windows - 1)
+
+
+def _window_counts(np_, cfg: SamplerConfig, nest) -> np.ndarray:
+    """[T, NW] true accesses of each thread-window (the walk-cost unit);
+    the affine per-iteration size covers rectangular (slope 0) and
+    triangular nests uniformly."""
+    from pluss.spec import nest_iteration_size_affine
+
+    T = np_.owned.shape[0]
+    CS = cfg.chunk_size
+    g = np_.owned[:, :, None].astype(np.int64) * CS + np.arange(CS)
+    valid = (np_.owned[:, :, None] >= 0) & (g < np_.sched.trip)
+    n0, n1 = nest_iteration_size_affine(nest)
+    slot = np.where(valid, n0 + n1 * g, 0)
+    return slot.reshape(T, np_.n_windows, -1).sum(axis=2)
+
+
+@functools.lru_cache(maxsize=64)
+def _prefix_fn(spec: LoopNestSpec, cfg: SamplerConfig, ni: int,
+               share_cap: int, window_accesses: int | None, m: int):
+    """jit[(T,)] -> per-window results of the exact chain over windows 0..m
+    (each window warmed by ALL its predecessors via the threaded carry)."""
+    pl = _plan_cached(spec, cfg, window_accesses)
+    np_ = pl.nests[ni]
+    bases = pl.spec.line_bases(cfg)
+    n_lines = pl.spec.total_lines(cfg)
+    pdt = jnp.dtype(pl.pos_dtype)
+    nest_base = jnp.asarray(pl.nest_base.astype(pl.pos_dtype))
+    win_shift = np_.window_rounds * cfg.chunk_size * np_.body
+    ranges = _array_ranges(np_.refs, pl.spec, cfg)
+
+    def one(t):
+        clock_row = None if np_.clock is None else jnp.asarray(np_.clock)[t]
+        owned_row = jnp.asarray(np_.owned)[t]
+        nb = nest_base[ni, t]
+
+        def step(last_pos, w):
+            last_pos, dh, ev, _ = _sort_window(
+                np_, np_.refs, ranges, cfg, owned_row, w, nb, bases,
+                pl.spec.array_index, pdt, last_pos, win_shift,
+                clock_row=clock_row,
+            )
+            sv, sc, snu = share_unique(ev, share_cap)
+            return last_pos, (dh, sv, sc, snu)
+
+        last_pos = jnp.full((n_lines,), -1, pdt)
+        _, ys = jax.lax.scan(step, last_pos,
+                             jnp.arange(m + 1, dtype=jnp.int32))
+        return ys
+
+    return pl, jax.jit(jax.vmap(one))
+
+
 def sampled_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                 rate: float = 0.1, seed: int = 0,
                 share_cap: int = SHARE_CAP,
-                window_accesses: int | None = None) -> SamplerResult:
+                window_accesses: int | None = None,
+                context_windows: int | None = None,
+                mode: str = "uniform") -> SamplerResult:
     """Estimate the per-thread histograms from a ``rate`` fraction of windows.
 
     Returns a :class:`SamplerResult` with FLOAT counts (scaled estimates);
     ``max_iteration_count`` reports the true full-stream access count the
     estimate stands for, and ``sampled_fraction`` the fraction of that
-    stream actually walked — ``nsel/NW`` rounding means it can exceed the
-    requested rate substantially at small window counts.
-    ``window_accesses`` sets the sample span (the K-chunk context of the
-    reference's ``getNextKChunksFrom``).
+    stream actually walked — counted windows PLUS their warm-up context,
+    so ``nsel/NW`` rounding and warming can push it well past the requested
+    rate at small window counts.
+    ``window_accesses`` sets the sample span; ``context_windows`` the
+    warm-up depth (default: auto-sized per nest so the context covers the
+    largest share span — see module docstring).
+
+    ``mode``:
+
+    - ``"uniform"`` — independent windows chosen uniformly at random, each
+      warmed by its own context; unbiased per window, but scaling mixes
+      the transient first windows with the steady tail.
+    - ``"prefix"`` — walk windows ``0..m`` (``m+1 ≈ rate*NW``) as ONE
+      exact chain (every carried reuse resolved) and let the last window
+      stand for the steady tail: ``estimate = Σ_{w<m} f(w) +
+      f(m)·(NW-m)``.  This is the classic warm-up-then-measure estimator
+      the reference's ``setStartPoint`` + K-chunk context surface implies;
+      for shift-invariant nests the steady windows are literally identical
+      (the template argument), so the estimate is near-exact at any rate.
     """
     if not 0.0 < rate <= 1.0:
         raise ValueError(f"sampling rate must be in (0, 1], got {rate}")
+    if mode not in ("uniform", "prefix"):
+        raise ValueError(f"unknown sampling mode {mode!r}")
     T = cfg.thread_num
     rng = np.random.default_rng(seed)
     hist = np.zeros((T, NBINS), np.float64)
     share_raw: list[dict] = [dict() for _ in range(T)]
     pl = None
     walked = 0.0
+    if mode == "prefix":
+        for ni in range(len(spec.nests)):
+            pl0 = _plan_cached(spec, cfg, window_accesses)
+            NW = pl0.nests[ni].n_windows
+            m = min(NW - 1, max(0, round(rate * NW) - 1))
+            pl, fn = _prefix_fn(spec, cfg, ni, share_cap, window_accesses, m)
+            dh, sv, sc, snu = fn(jnp.arange(T, dtype=jnp.int32))
+            dh = np.asarray(dh)               # [T, m+1, NBINS]
+            walked += float(dh.sum())
+            hist += dh[:, :m].sum(axis=1) + dh[:, m] * (NW - m)
+            for part, scale in (
+                (merge_share_windows([np.asarray(sv)[:, :m]],
+                                     [np.asarray(sc)[:, :m]],
+                                     [np.asarray(snu)[:, :m]],
+                                     share_cap, T), 1.0),
+                (merge_share_windows([np.asarray(sv)[:, m:]],
+                                     [np.asarray(sc)[:, m:]],
+                                     [np.asarray(snu)[:, m:]],
+                                     share_cap, T), float(NW - m)),
+            ):
+                for t in range(T):
+                    for v, c in part[t].items():
+                        share_raw[t][v] = share_raw[t].get(v, 0.0) + c * scale
+                        walked += c
+        return SamplerResult(
+            noshare_dense=hist,
+            share_raw=share_raw,
+            share_ratio=T - 1,
+            max_iteration_count=pl.total_count,
+            sampled_fraction=walked / pl.total_count if pl.total_count
+            else 0.0,
+        )
     for ni in range(len(spec.nests)):
-        pl, fn = _window_fn(spec, cfg, ni, share_cap, window_accesses)
-        NW = pl.nests[ni].n_windows
+        pl0 = _plan_cached(spec, cfg, window_accesses)
+        warm_k = _auto_context(pl0.nests[ni], cfg) \
+            if context_windows is None else \
+            min(context_windows, pl0.nests[ni].n_windows - 1)
+        pl, fn = _window_fn(spec, cfg, ni, share_cap, window_accesses,
+                            warm_k)
+        np_ = pl.nests[ni]
+        NW = np_.n_windows
         nsel = max(1, round(rate * NW))
-        # the sampler vmaps over T x nsel fresh-carry windows at once — a
-        # fan-out plan()'s default guard cannot see; re-check here so huge
-        # selections fail actionably instead of OOMing XLA
-        est = sort_window_bytes(pl.nests[ni], cfg, pl.pos_dtype,
+        # the sampler vmaps over T x nsel context-warmed windows at once —
+        # a fan-out plan()'s default guard cannot see; re-check here so
+        # huge selections fail actionably instead of OOMing XLA
+        est = sort_window_bytes(np_, cfg, pl.pos_dtype,
                                 pl.spec.total_lines(cfg)) * T * nsel
         limit = int(os.environ.get("PLUSS_MAX_SORT_WINDOW_BYTES", 8 << 30))
         if est > limit:
@@ -137,13 +280,20 @@ def sampled_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         hist += dh.sum(axis=1) * scale
         part = merge_share_windows([np.asarray(sv)], [np.asarray(sc)],
                                    [np.asarray(snu)], share_cap, T)
-        # every walked access lands in exactly one bucket (event, cold, or
-        # share), so the unscaled masses measure the TRUE walked fraction
+        # every counted access lands in exactly one bucket (event, cold, or
+        # share), so the unscaled masses measure the counted fraction ...
         walked += float(dh.sum())
         for t in range(T):
             for v, c in part[t].items():
                 share_raw[t][v] = share_raw[t].get(v, 0.0) + c * scale
                 walked += c
+        # ... and the warm-up context is walked work too (tails-only, but
+        # walked): charge each sampled window's real context windows
+        if warm_k:
+            counts = _window_counts(np_, cfg, spec.nests[ni])
+            for w in sel.tolist():
+                lo = max(0, w - warm_k)
+                walked += float(counts[:, lo:w].sum())
     return SamplerResult(
         noshare_dense=hist,
         share_raw=share_raw,
@@ -165,7 +315,9 @@ def mrc_l2_error(a: np.ndarray, b: np.ndarray) -> float:
 def mrc_error_table(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                     rates=(0.05, 0.1, 0.25, 0.5, 1.0), seed: int = 0,
                     share_cap: int = SHARE_CAP,
-                    window_accesses: int | None = None):
+                    window_accesses: int | None = None,
+                    context_windows: int | None = None,
+                    mode: str = "uniform"):
     """[(rate, sampled_fraction_of_accesses, mrc_l2_error)] vs full run.
 
     The payoff table the reference's dormant sampling surface was built
@@ -180,7 +332,8 @@ def mrc_error_table(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     )
     out = []
     for rate in rates:
-        est = sampled_run(spec, cfg, rate, seed, share_cap, window_accesses)
+        est = sampled_run(spec, cfg, rate, seed, share_cap, window_accesses,
+                          context_windows, mode)
         est_curve = mrc.aet_mrc(
             cri.distribute(est.noshare_list(), est.share_list(),
                            cfg.thread_num),

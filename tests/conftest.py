@@ -9,6 +9,13 @@ production entry points (cli/bench, which need int64 positions for >2^31
 access streams); tests that need the x64-off behavior pin it off explicitly.
 """
 
+import os  # noqa: E402
+
+# plan artifacts (templates/overlays) must always rebuild under test — a
+# stale cache entry could mask analysis bugs (tests that exercise the cache
+# opt back in with PLUSS_PLAN_CACHE_DIR)
+os.environ.setdefault("PLUSS_NO_PLAN_CACHE", "1")
+
 from pluss.utils.platform import enable_x64, force_cpu  # noqa: E402
 
 force_cpu(n_virtual_devices=8)

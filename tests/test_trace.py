@@ -162,3 +162,41 @@ def test_replay_file_text_fallback(tmp_path):
     assert res.histogram() == oracle_replay([0, 64, 0])
     with pytest.raises(ValueError, match="unknown trace format"):
         trace.replay_file(str(pt), fmt="bogus")
+
+
+def test_shard_replay_file_matches_replay_file(tmp_path):
+    """Disk-streamed sharded replay == single-device streamed replay, on a
+    trace LARGER than any single slice buffer (VERDICT r2 task 5): 8
+    segments x 4 windows each, streamed 2 windows per call."""
+    import numpy as np
+
+    from pluss import trace
+
+    rng = np.random.default_rng(11)
+    window = 1 << 10
+    n = 8 * 4 * window - 137          # ragged tail exercises the padding
+    addrs = (rng.integers(0, 1 << 13, n, dtype=np.int64) << 6).astype("<u8")
+    p = tmp_path / "t.bin"
+    addrs.tofile(p)
+    a = trace.replay_file(str(p), window=window)
+    b = trace.shard_replay_file(str(p), window=window, batch_windows=2,
+                                initial_capacity=1 << 8)
+    assert a.total_count == b.total_count == n
+    np.testing.assert_array_equal(a.hist, b.hist)
+
+
+def test_shard_replay_file_single_call(tmp_path):
+    import numpy as np
+
+    from pluss import trace
+
+    rng = np.random.default_rng(12)
+    window = 1 << 9
+    n = 3 * window + 41
+    addrs = (rng.integers(0, 1 << 10, n, dtype=np.int64) << 6).astype("<u8")
+    p = tmp_path / "t.bin"
+    addrs.tofile(p)
+    a = trace.replay(np.asarray(np.frombuffer(addrs.tobytes(), "<u8"),
+                                np.int64), window=window)
+    b = trace.shard_replay_file(str(p), window=window)
+    np.testing.assert_array_equal(a.hist, b.hist)
