@@ -192,6 +192,9 @@ def main(argv: list[str] | None = None) -> int:
         from pluss import sampling
 
         rates = [float(x) for x in args.rates.split(",") if x]
+        if args.sample_mode == "prefix" and args.context is not None:
+            print("pluss: --context is ignored in prefix mode (the chain "
+                  "is its own context)", file=sys.stderr)
         tbl = sampling.mrc_error_table(spec, cfg, rates,
                                        share_cap=args.share_cap,
                                        window_accesses=args.window,

@@ -91,7 +91,11 @@ def _window_fn(spec: LoopNestSpec, cfg: SamplerConfig, ni: int,
         clock_row = None if np_.clock is None else jnp.asarray(np_.clock)[t]
         owned_row = jnp.asarray(np_.owned)[t]
         nb = nest_base[ni, t]
-        for j in range(warm_k):
+
+        def warm(j, last_pos):
+            # one traced body regardless of warm_k (a python loop would
+            # inline warm_k sort windows into the HLO); clamped early
+            # windows re-walk window 0 and mask the result out
             wc = jnp.maximum(w - warm_k + j, 0)
             lp2, _, _, _ = _sort_window(
                 np_, np_.refs, ranges, cfg, owned_row, wc, nb, bases,
@@ -100,7 +104,10 @@ def _window_fn(spec: LoopNestSpec, cfg: SamplerConfig, ni: int,
             )
             # apply the context's tails only when it precedes the sampled
             # window (w < warm_k has fewer real context windows)
-            last_pos = jnp.where(wc < w, lp2, last_pos)
+            return jnp.where(wc < w, lp2, last_pos)
+
+        if warm_k:
+            last_pos = jax.lax.fori_loop(0, warm_k, warm, last_pos)
         _, dh, ev, _ = _sort_window(
             np_, np_.refs, ranges, cfg, owned_row, w, nb, bases,
             pl.spec.array_index, pdt, last_pos, win_shift,
@@ -201,7 +208,8 @@ def sampled_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     - ``"prefix"`` — walk windows ``0..m`` (``m+1 ≈ rate*NW``) as ONE
       exact chain (every carried reuse resolved) and let the last window
       stand for the steady tail: ``estimate = Σ_{w<m} f(w) +
-      f(m)·(NW-m)``.  This is the classic warm-up-then-measure estimator
+      f(m)·(NW-m)``.  ``context_windows`` and ``seed`` are meaningless
+      here (the chain IS the context; nothing is random) and are ignored.  This is the classic warm-up-then-measure estimator
       the reference's ``setStartPoint`` + K-chunk context surface implies;
       for shift-invariant nests the steady windows are literally identical
       (the template argument), so the estimate is near-exact at any rate.

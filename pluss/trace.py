@@ -620,9 +620,14 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
     donate = (1, 2, 3) if jax.default_backend() != "cpu" else ()
 
     def read_slice(f, d: int, k: int) -> np.ndarray:
-        """Device d's k-th slice of ids, zero-padded to SB*window."""
+        """Device d's k-th slice of ids, zero-padded to SB*window.
+
+        The read clips at BOTH the stream end and the segment end — when S
+        is not a multiple of batch_windows the final slice would otherwise
+        spill into segment d+1, whose owner also processes those refs."""
         lo = d * S * window + k * SB * window
-        count = max(0, min(SB * window, n - lo))
+        seg_end = (d + 1) * S * window
+        count = max(0, min(SB * window, n - lo, seg_end - lo))
         out = np.zeros(SB * window, np.int32)
         if count:
             f.seek(lo * 8)
@@ -653,7 +658,10 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
                 s, line_w = xs
                 pos_w = base + s.astype(pdt) * window \
                     + jnp.arange(window, dtype=pdt)
-                valid_w = pos_w < n
+                # s >= S marks a ragged final slice's padding windows: their
+                # positions fall inside the NEXT device's segment and must
+                # not be counted here
+                valid_w = (pos_w < n) & (s < S)
                 key_s, pos_s, span_s, valid_i = sort_stream(
                     line_w, pos_w, None, valid_w, pos_sorted=True)
                 ev, last_pos = window_events(key_s, pos_s, span_s, valid_i,

@@ -1,0 +1,45 @@
+"""Seeded hypothesis soak over the property generators (run per round).
+
+Re-wraps tests/test_property.py's differential properties with a larger
+example budget and a fresh seed.  Not part of the suite; run manually:
+``python soak.py [examples] [seed]``.
+"""
+
+import sys
+import time
+
+from hypothesis import HealthCheck, given, seed, settings, strategies as st
+
+sys.path.insert(0, ".")
+import tests.conftest  # noqa: F401  (CPU mesh + x64 + no plan cache)
+import tests.test_property as tp
+
+
+def soak(name, inner, budget, sd, **strats):
+    t0 = time.perf_counter()
+    fn = seed(sd)(settings(
+        max_examples=budget, deadline=None,
+        suppress_health_check=list(HealthCheck),
+    )(given(**strats)(inner)))
+    fn()
+    print(f"soak {name}: {budget} examples OK in "
+          f"{time.perf_counter() - t0:.0f}s", flush=True)
+
+
+def main():
+    budget = int(sys.argv[1]) if len(sys.argv) > 1 else 150
+    sd = int(sys.argv[2]) if len(sys.argv) > 2 else int(time.time())
+    print(f"soak seed {sd}", flush=True)
+    soak("specs", tp.test_random_specs_match_oracle.hypothesis.inner_test,
+         budget, sd, spec=tp.specs(), cfg=tp.configs(),
+         window=st.sampled_from([None, 64, 256]))
+    soak("schedules",
+         tp.test_random_schedules_match_oracle.hypothesis.inner_test,
+         (2 * budget) // 3, sd + 1, args=tp.schedules())
+    soak("shard", tp.test_random_specs_shard_matches_oracle.hypothesis
+         .inner_test, budget // 3, sd + 2, spec=tp.specs(),
+         cfg=tp.configs())
+
+
+if __name__ == "__main__":
+    main()

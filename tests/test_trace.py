@@ -200,3 +200,23 @@ def test_shard_replay_file_single_call(tmp_path):
                                 np.int64), window=window)
     b = trace.shard_replay_file(str(p), window=window)
     np.testing.assert_array_equal(a.hist, b.hist)
+
+
+def test_shard_replay_file_ragged_slice_boundary(tmp_path):
+    """S not divisible by batch_windows: the final slice of each segment
+    must clip at the segment end instead of spilling into (and double
+    counting with) the next device's segment — code-review r3 finding."""
+    import numpy as np
+
+    from pluss import trace
+
+    rng = np.random.default_rng(13)
+    window = 1 << 8
+    n = 8 * 3 * window  # S=3 windows/segment; batch_windows=2 -> ragged
+    addrs = (rng.integers(0, 1 << 11, n, dtype=np.int64) << 6).astype("<u8")
+    p = tmp_path / "t.bin"
+    addrs.tofile(p)
+    a = trace.replay_file(str(p), window=window)
+    b = trace.shard_replay_file(str(p), window=window, batch_windows=2)
+    assert int(a.hist.sum()) == n
+    np.testing.assert_array_equal(a.hist, b.hist)
