@@ -228,7 +228,7 @@ class NestPlan:
         for its (eligible) arrays, so the two must agree exactly.
         ``var_refs`` arrays emit device share events in every window.
         """
-        if self.tpl is None or self.clean is None:
+        if self.clean is None or (self.tpl is None and not self.overlays):
             return np.zeros(self.n_windows, bool)
         return self.clean.all(axis=0)
 
@@ -604,12 +604,12 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         if build_templates and asg is None and not tri and \
                 not nest_has_varying_start(spec.nests[ni]) and \
                 W * cfg.chunk_size * body <= MAX_TEMPLATE_WINDOW:
+            clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
+            cache_key = _plan_cache_key(
+                spec, cfg, ni, W, NW) if start_point is None else None
+            cached = _plan_cache_get(cache_key) if cache_key else None
             tpl_refs, split_var = _split_ref_groups(refs, sched, cfg)
             if tpl_refs:
-                clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
-                cache_key = _plan_cache_key(
-                    spec, cfg, ni, W, NW) if start_point is None else None
-                cached = _plan_cache_get(cache_key) if cache_key else None
                 if cached is not None:
                     tpl = cached["tpl"]
                 else:
@@ -623,10 +623,12 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         var_novl = var_refs
         # overlay build: only for clean (ultra) windows under the default
         # static schedule with no resume skip — the closed forms assume
-        # cid = (w*W + r)*T + t.  Verification replays the algebra against
-        # brute windows, so a bad eligibility judgment degrades to the sort
-        # path instead of a wrong histogram.
-        if build_overlays and tpl is not None and var_refs and \
+        # cid = (w*W + r)*T + t.  Templates are NOT required (a nest whose
+        # only array is mixed-coefficient has none); clean windows are.
+        # Verification replays the algebra against brute windows, so a bad
+        # eligibility judgment degrades to the sort path instead of a
+        # wrong histogram.
+        if build_overlays and clean is not None and var_refs and \
                 (start_point is None or ni != 0) and \
                 not os.environ.get("PLUSS_NO_OVERLAY"):
             if cached is not None and cached.get("overlays") is not None:
@@ -669,8 +671,8 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                     _plan_cache_put(cache_key,
                                     {"tpl": tpl, "overlays": overlays})
         elif cache_key and cached is None and tpl is not None:
-            # cache the template even when overlays are skipped (shard
-            # backend, resume runs build their own keyless plans)
+            # cache the template even when overlays are skipped (the shard
+            # backend; resume runs build their own keyless plans)
             _plan_cache_put(cache_key, {"tpl": tpl, "overlays": None})
         nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean,
                               var_refs, overlays=overlays,
@@ -702,11 +704,13 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             streams.append(("sort", np_.refs,
                             "a static schedule (template path), a finer "
                             "chunk size"))
-        if np_.var_refs_novl and np_.tpl is not None:
+        if np_.var_refs_novl and np_.ultra_windows().any():
             # overlaid arrays are excluded: ultra windows process them in
             # O(lines) with no sort at all (non-ultra windows are already
-            # covered by the full-refs "sort" stream check above)
-            streams.append(("template's var (template-ineligible) part",
+            # covered by the full-refs "sort" stream check above).  Gated
+            # on ultra windows EXISTING, not on a template: an overlay-only
+            # nest (tpl None) still sorts var_refs_novl in ultra windows
+            streams.append(("ultra window's var (sort-path) part",
                             np_.var_refs_novl, "a finer chunk size"))
         for label, refs_, remedy in streams:
             est = sort_window_bytes(np_, cfg, pos_dtype, n_lines,
@@ -905,19 +909,25 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                 ys = ys + zero_minus(sv.dtype)
             return (last_pos, hist + dh), ys
 
-        if np_.tpl is not None:
+        if np_.tpl is not None or np_.overlays:
+            # an ultra window may carry a template, overlays, or both (a
+            # nest whose only array is mixed-coefficient has no template)
             tpl = np_.tpl
-            hline = jnp.asarray(tpl.head_line)
-            hpos = jnp.asarray(tpl.head_pos.astype(pl.pos_dtype))
-            hspan = jnp.asarray(tpl.head_span)
-            hdl = jnp.asarray(tpl.head_dline)
-            tline = jnp.asarray(tpl.tail_line)
-            tpos = jnp.asarray(tpl.tail_pos.astype(pl.pos_dtype))
-            tdl = jnp.asarray(tpl.tail_dline)
-            lhist = jnp.asarray(tpl.local_hist.astype(pl.pos_dtype))
-            hs_idx = jnp.asarray(tpl.hs_idx)
-            units0 = tid - tpl.t0
-            shift_w = jnp.asarray(tpl.pos_shift, pdt)
+            if tpl is not None:
+                hline = jnp.asarray(tpl.head_line)
+                hpos = jnp.asarray(tpl.head_pos.astype(pl.pos_dtype))
+                hspan = jnp.asarray(tpl.head_span)
+                hdl = jnp.asarray(tpl.head_dline)
+                tline = jnp.asarray(tpl.tail_line)
+                tpos = jnp.asarray(tpl.tail_pos.astype(pl.pos_dtype))
+                tdl = jnp.asarray(tpl.tail_dline)
+                lhist = jnp.asarray(tpl.local_hist.astype(pl.pos_dtype))
+                hs_idx = jnp.asarray(tpl.hs_idx)
+                units0 = tid - tpl.t0
+                shift_w = jnp.asarray(tpl.pos_shift, pdt)
+            else:
+                hline = hpos = hspan = hdl = tline = tpos = tdl = None
+                lhist = hs_idx = units0 = shift_w = None
 
             def ultra_step(carry, w, np_=np_, tpl=tpl, hline=hline, hpos=hpos,
                            hspan=hspan, hdl=hdl, tline=tline,
@@ -949,42 +959,43 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                     hist = hist + dh_ov
                     ov_plus.append((plus["reuse"], plus["share"]))
                     ov_minus.append(minus)
-                units = (w - tpl.w0) * tpl.unit_w + units0
-                dpos = (w - tpl.w0).astype(pdt) * shift_w + nb
-                if tpl.head_runs is not None:
-                    carried = jnp.concatenate([
-                        jax.lax.dynamic_slice(
-                            last_pos, (int(ls) + int(dl) * units,), (int(ln),)
-                        )
-                        for ls, _, ln, dl in tpl.head_runs
-                    ]) if len(tpl.head_runs) else last_pos[:0]
-                else:
-                    carried = last_pos[hline + hdl * units]
-                cold = carried < 0
-                reuse = (hpos + dpos) - carried
-                share = ~cold & share_mask(reuse, hspan)
-                evt = ~cold & ~share
-                bins = jnp.where(evt, log2_bin(reuse), 0)
-                wgt = (cold | evt).astype(pdt)
-                hist = hist + lhist + bin_histogram(bins, wgt)
-                newv = tpos + dpos
-                if tpl.tail_runs is not None:
-                    for ls, off, ln, dl in tpl.tail_runs:
-                        last_pos = jax.lax.dynamic_update_slice(
-                            last_pos, newv[int(off):int(off) + int(ln)],
-                            (int(ls) + int(dl) * units,),
-                        )
-                else:
-                    last_pos = last_pos.at[tline + tdl * units].set(newv)
+                cand = list(ov_plus)
+                if tpl is not None:
+                    units = (w - tpl.w0) * tpl.unit_w + units0
+                    dpos = (w - tpl.w0).astype(pdt) * shift_w + nb
+                    if tpl.head_runs is not None:
+                        carried = jnp.concatenate([
+                            jax.lax.dynamic_slice(
+                                last_pos, (int(ls) + int(dl) * units,),
+                                (int(ln),)
+                            )
+                            for ls, _, ln, dl in tpl.head_runs
+                        ]) if len(tpl.head_runs) else last_pos[:0]
+                    else:
+                        carried = last_pos[hline + hdl * units]
+                    cold = carried < 0
+                    reuse = (hpos + dpos) - carried
+                    share = ~cold & share_mask(reuse, hspan)
+                    evt = ~cold & ~share
+                    bins = jnp.where(evt, log2_bin(reuse), 0)
+                    wgt = (cold | evt).astype(pdt)
+                    hist = hist + lhist + bin_histogram(bins, wgt)
+                    newv = tpos + dpos
+                    if tpl.tail_runs is not None:
+                        for ls, off, ln, dl in tpl.tail_runs:
+                            last_pos = jax.lax.dynamic_update_slice(
+                                last_pos, newv[int(off):int(off) + int(ln)],
+                                (int(ls) + int(dl) * units,),
+                            )
+                    else:
+                        last_pos = last_pos.at[tline + tdl * units].set(newv)
+                    if tpl.hs_idx.shape[0]:
+                        cand.append((reuse[hs_idx], share[hs_idx]))
                 # share extraction over all sources: the template's
                 # share-capable head candidates + the var window's events +
                 # the overlays' added events
-                cand = []
-                if tpl.hs_idx.shape[0]:
-                    cand.append((reuse[hs_idx], share[hs_idx]))
                 if ev_var is not None:
                     cand.append((ev_var["reuse"], ev_var["share"]))
-                cand.extend(ov_plus)
                 if cand:
                     sub = {
                         "reuse": jnp.concatenate([c[0] for c in cand]),
@@ -992,7 +1003,7 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
                     }
                     sv, sc, snu = share_unique(sub, share_cap)
                 else:
-                    sv = jnp.zeros((share_cap,), reuse.dtype)
+                    sv = jnp.zeros((share_cap,), pdt)
                     sc = jnp.zeros((share_cap,), jnp.int32)
                     snu = jnp.int32(0)
                 ys = (sv, sc, snu)

@@ -35,9 +35,9 @@ def main():
          window=st.sampled_from([None, 64, 256]))
     soak("schedules",
          tp.test_random_schedules_match_oracle.hypothesis.inner_test,
-         (2 * budget) // 3, sd + 1, args=tp.schedules())
+         max(1, (2 * budget) // 3), sd + 1, args=tp.schedules())
     soak("shard", tp.test_random_specs_shard_matches_oracle.hypothesis
-         .inner_test, budget // 3, sd + 2, spec=tp.specs(),
+         .inner_test, max(1, budget // 3), sd + 2, spec=tp.specs(),
          cfg=tp.configs())
 
 

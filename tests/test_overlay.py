@@ -103,3 +103,57 @@ def test_ineligible_shapes_fall_back():
     assert ov is None
     pl = engine.plan(syrk(20), cfg)
     assert _overlay_arrays(pl) == []
+
+
+@pytest.mark.parametrize("n,T,CS,cls", [
+    (16, 4, 4, 8),
+    (24, 3, 4, 8),
+    (32, 2, 8, 16),
+    (48, 4, 2, 8),
+    (64, 8, 2, 64),
+    (40, 5, 4, 8),
+])
+def test_overlay_grid_matches_oracle(n, T, CS, cls):
+    """Overlay-eligible (n, threads, chunk, line-size) grid: the overlay
+    must ENGAGE (not silently fall back) and match the oracle exactly."""
+    cfg = SamplerConfig(thread_num=T, chunk_size=CS, cls=cls)
+    spec = syrk(n)
+    pl = engine.plan(spec, cfg)
+    assert _overlay_arrays(pl) == ["A"], "overlay unexpectedly ineligible"
+    r = engine.run(spec, cfg)
+    o = OracleSampler(spec, cfg).run()
+    assert r.max_iteration_count == o.max_iteration_count
+    for t in range(T):
+        assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
+        assert r.share_dict(t) == \
+            {k: dict(v) for k, v in o.share[t].items() if v}, f"tid {t} share"
+
+
+def test_overlay_two_nest_carry():
+    """Cross-nest carries: a second nest re-touching the overlaid array
+    must see absolute carried positions (the nb-offset contract of
+    overlay.device_window)."""
+    from pluss.spec import Loop, LoopNestSpec, Ref
+    from pluss.spec import share_span_formula
+
+    n = 16
+    span = share_span_formula(n)
+    def a_nest():
+        inner = Loop(trip=n, body=(
+            Ref("A0", "A", addr_terms=((0, n), (2, 1))),
+            Ref("A1", "A", addr_terms=((1, n), (2, 1)), share_span=span),
+        ))
+        return Loop(trip=n, body=(Loop(trip=n, body=(inner,)),))
+
+    spec = LoopNestSpec(name="twice", arrays=(("A", n * n),),
+                        nests=(a_nest(), a_nest()))
+    cfg = SamplerConfig(cls=8)
+    pl = engine.plan(spec, cfg)
+    assert _overlay_arrays(pl) == ["A", "A"]
+    r = engine.run(spec, cfg)
+    o = OracleSampler(spec, cfg).run()
+    assert r.max_iteration_count == o.max_iteration_count
+    for t in range(cfg.thread_num):
+        assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
+        assert r.share_dict(t) == \
+            {k: dict(v) for k, v in o.share[t].items() if v}, f"tid {t} share"
