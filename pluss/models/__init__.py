@@ -9,7 +9,7 @@ from pluss.models.gemm import gemm
 from pluss.models.linalg import (atax, bicg, doitgen, gemver, gesummv,
                                  jacobi2d, mvt)
 from pluss.models.polybench import (correlation, covariance, mm2, mm3,
-                                    symm, syrk, syrk_triangular, trmm)
+                                    symm, syr2k, syrk, syrk_triangular, trmm)
 from pluss.models.stencils import conv2d, fdtd2d, heat3d, stencil3d
 
 REGISTRY = {
@@ -17,6 +17,7 @@ REGISTRY = {
     "2mm": mm2,
     "3mm": mm3,
     "syrk": syrk,
+    "syr2k": syr2k,
     "syrk_tri": syrk_triangular,
     "trmm": trmm,
     "symm": symm,
@@ -36,7 +37,7 @@ REGISTRY = {
 }
 
 __all__ = [
-    "gemm", "mm2", "mm3", "syrk", "conv2d", "stencil3d",
+    "gemm", "mm2", "mm3", "syrk", "syr2k", "conv2d", "stencil3d",
     "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d",
     "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm", "covariance", "correlation",
     "REGISTRY",

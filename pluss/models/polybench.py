@@ -106,6 +106,37 @@ def syrk(n: int = 128) -> LoopNestSpec:
     )
 
 
+def syr2k(n: int = 128) -> LoopNestSpec:
+    """syr2k (rectangular): ``C = beta*C + alpha*(A*B^T + B*A^T)``.
+
+    BOTH operand arrays carry the symmetric moving/sweeping ref pair
+    (``A[i][k]``/``A[j][k]`` and ``B[i][k]``/``B[j][k]``), so this is the
+    two-overlay stress shape: each array gets its own interleave overlay
+    (pluss.overlay) inside one nest.  ``A1``/``B1`` are the cross-thread
+    references (row index j does not involve the parallel iterator), like
+    GEMM's B0 (``/root/reference/src/gemm_sampler.rs:196-201``).
+    """
+    span = share_span_formula(n)
+    c = lambda nm: Ref(nm, "C", addr_terms=((0, n), (1, 1)))
+    inner = Loop(
+        trip=n,
+        body=(
+            Ref("A0", "A", addr_terms=((0, n), (2, 1))),
+            Ref("B1", "B", addr_terms=((1, n), (2, 1)), share_span=span),
+            Ref("B0", "B", addr_terms=((0, n), (2, 1))),
+            Ref("A1", "A", addr_terms=((1, n), (2, 1)), share_span=span),
+            c("C2"),
+            c("C3"),
+        ),
+    )
+    nest = Loop(trip=n, body=(Loop(trip=n, body=(c("C0"), c("C1"), inner)),))
+    return LoopNestSpec(
+        name=f"syr2k{n}",
+        arrays=(("C", n * n), ("A", n * n), ("B", n * n)),
+        nests=(nest,),
+    )
+
+
 def syrk_triangular(n: int = 128) -> LoopNestSpec:
     """syrk, PolyBench 4.2 triangular form: only ``j <= i`` is touched.
 

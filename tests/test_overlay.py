@@ -157,3 +157,16 @@ def test_overlay_two_nest_carry():
         assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
         assert r.share_dict(t) == \
             {k: dict(v) for k, v in o.share[t].items() if v}, f"tid {t} share"
+
+
+def test_syr2k_double_overlay_matches_oracle():
+    """syr2k: BOTH operand arrays get overlays in one nest (A and B each
+    carry the moving/sweeping pair); exact vs oracle, 21st model family."""
+    from pluss.models import syr2k
+    from tests.test_engine import assert_matches_oracle
+
+    cfg = SamplerConfig()
+    spec = syr2k(32)
+    pl = engine.plan(spec, cfg)
+    assert sorted(_overlay_arrays(pl)) == ["A", "B"]
+    assert_matches_oracle(spec, cfg)
