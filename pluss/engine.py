@@ -1101,17 +1101,30 @@ def _unpack(flat: np.ndarray, pl: StreamPlan, share_cap: int):
 @functools.lru_cache(maxsize=64)
 def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
              assignment=None, start_point=None, window_accesses=None,
-             backend: str = "vmap"):
+             backend: str = "vmap", thread_batch: int | None = None):
     """(plan, jitted fn) for a workload; cached so repeat runs reuse the XLA
     executable (the reference's `speed` mode re-runs the same sampler 3x,
-    main.rs:23-35).  The jitted fn returns the packed [T, L] result matrix."""
+    main.rs:23-35).  The jitted fn returns the packed [T, L] result matrix.
+
+    ``thread_batch`` (vmap backend only) processes the simulated threads in
+    sequential chunks of that size (``lax.map(..., batch_size=...)``) inside
+    ONE executable — peak device memory scales with the chunk, not with T.
+    Triangular nests' static-max sort windows need this at large sizes
+    (4-way-concurrent 16.8M-entry windows exceed what the device survives)."""
+    if thread_batch is not None:
+        if thread_batch < 1:
+            raise ValueError(f"thread_batch must be >= 1, got {thread_batch}")
+        if thread_batch >= cfg.thread_num:
+            thread_batch = None   # full vmap; guard must use concurrency T
     pl = plan(spec, cfg, assignment, start_point, window_accesses,
-              sort_concurrency=1 if backend == "seq" else None)
+              sort_concurrency=1 if backend == "seq" else thread_batch)
 
     if backend == "vmap":
         def f(tids):
-            return jax.vmap(
-                lambda t: _thread_pipeline_packed(t, pl, share_cap))(tids)
+            g = lambda t: _thread_pipeline_packed(t, pl, share_cap)
+            if thread_batch:
+                return jax.lax.map(g, tids, batch_size=thread_batch)
+            return jax.vmap(g)(tids)
         return pl, jax.jit(f)
     if backend == "seq":
         one = jax.jit(lambda t: _thread_pipeline_packed(t, pl, share_cap))
@@ -1264,19 +1277,21 @@ def overlay_static_share(share_raw: list[dict], pl: StreamPlan) -> None:
 
 def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         share_cap: int = SHARE_CAP, assignment=None, start_point=None,
-        window_accesses=None, backend: str = "vmap") -> SamplerResult:
+        window_accesses=None, backend: str = "vmap",
+        thread_batch: int | None = None) -> SamplerResult:
     """Run the sampler.
 
     ``backend``: 'vmap' (default — simulated threads as a vmap axis) or 'seq'
     (one thread at a time), mirroring the reference's backend trio; the
     device-sharded backend lives in :mod:`pluss.parallel`.
+    ``thread_batch``: see :func:`compiled`.
     """
     if assignment is not None:
         assignment = tuple(
             tuple(a) if a is not None else None for a in assignment
         )
     pl, f = compiled(spec, cfg, share_cap, assignment, start_point,
-                     window_accesses, backend)
+                     window_accesses, backend, thread_batch)
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
     hist, share_ys = _unpack(np.asarray(f(tids)), pl, share_cap)
     # share_ys: per nest (svals [T, NW, cap], scnts, snu [T, NW]), plus the

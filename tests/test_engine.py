@@ -303,3 +303,19 @@ def test_plan_cache_roundtrip(tmp_path, monkeypatch):
     assert [o.array for o in n1.overlays] == [o.array for o in n2.overlays]
     np.testing.assert_array_equal(n1.overlays[0].s_hist_prefix,
                                   n2.overlays[0].s_hist_prefix)
+
+
+def test_thread_batch_matches_vmap():
+    """lax.map thread batching (peak-memory knob) is result-identical to
+    the full vmap."""
+    import numpy as np
+
+    from pluss.models import syrk
+
+    spec, cfg = syrk(16), SamplerConfig(cls=8)
+    a = run(spec, cfg)
+    b = run(spec, cfg, thread_batch=2)
+    c = run(spec, cfg, thread_batch=1)
+    np.testing.assert_array_equal(a.noshare_dense, b.noshare_dense)
+    np.testing.assert_array_equal(a.noshare_dense, c.noshare_dense)
+    assert a.share_raw == b.share_raw == c.share_raw
