@@ -1290,6 +1290,9 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         assignment = tuple(
             tuple(a) if a is not None else None for a in assignment
         )
+    if thread_batch is not None and thread_batch >= cfg.thread_num:
+        thread_batch = None   # normalize BEFORE the lru-cached compile:
+        # equivalent configs must share one executable cache entry
     pl, f = compiled(spec, cfg, share_cap, assignment, start_point,
                      window_accesses, backend, thread_batch)
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
