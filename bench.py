@@ -241,8 +241,9 @@ def bench_trace(n_refs: int) -> None:
             base_s = time.perf_counter() - t0
     except (RuntimeError, MemoryError) as e:
         log(f"bench: native trace baseline unavailable: {e}")
-    # the metric NAME keeps the requested size so round-to-round tracking
-    # stays keyed on one string; the actually-replayed prefix rides along
+    # the metric NAME keeps the REQUESTED size so round-to-round tracking
+    # stays keyed on one string; check refs_replayed (and the stderr log)
+    # to see whether a slow feed shrank the actually-replayed prefix
     emit(f"trace{n_refs}_replay_refs_per_sec", n_run, best_s, base_s,
          refs_replayed=n_run)
 

@@ -286,3 +286,33 @@ def test_validation_errors():
                 Ref("X0", "X", addr_terms=((0, 4),)),
             )),
         )))
+
+
+def test_tri_buckets_engage_and_match_oracle():
+    """Size-bucketed triangular segments: multi-window tri nests split into
+    buckets with per-bucket static trips (engine._tri_buckets); results
+    must stay oracle-exact across every triangular family."""
+    from pluss import engine
+    from pluss.models import REGISTRY
+    from tests.test_engine import assert_matches_oracle
+
+    for name in ("syrk_tri", "trmm", "symm", "covariance"):
+        spec = REGISTRY[name](64)
+        pl = engine.plan(spec, engine.DEFAULT, window_accesses=1)
+        nb = [len(n.tri_buckets) for n in pl.nests
+              if n.clock is not None and n.tri_buckets]
+        assert nb and all(b > 1 for b in nb), f"{name}: buckets missing"
+        assert_matches_oracle(spec, engine.DEFAULT, window_accesses=1)
+
+
+def test_tri_buckets_shrink_trips():
+    from pluss import engine
+    from pluss.models import syrk_triangular
+
+    pl = engine.plan(syrk_triangular(64), engine.DEFAULT, window_accesses=1)
+    np_ = pl.nests[0]
+    assert np_.tri_buckets is not None
+    # first bucket's bounded levels must be strictly tighter than the last's
+    first = np_.tri_buckets[0][1][0].trips
+    last = np_.tri_buckets[-1][1][0].trips
+    assert first != last and all(a <= b for a, b in zip(first, last))
