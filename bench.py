@@ -11,8 +11,15 @@ the SAME workload on this host — the stand-in for the reference's serialized
 Rust/C++ backends (its Rayon/spawn backends hold whole-lifetime locks and run
 sequentially, SURVEY.md Q2, so the native walk is a faithful proxy).
 
-Prints one JSON line PER METRIC on stdout — the flagship GEMM line LAST (it
-is the round's headline number); all diagnostics go to stderr.
+Prints one JSON line PER METRIC on stdout — the flagship GEMM line FIRST so
+the round record always holds the headline number, then the aux metrics,
+each gated on a GLOBAL wall budget (PLUSS_BENCH_BUDGET_S, default 1200 s):
+an aux metric whose estimated cost exceeds the remaining budget is skipped
+with a logged reason instead of timing the whole bench out (round 3's record
+died at rc=124 with the flagship still queued — never again).  Native C++
+baselines are measured once and cached on disk keyed by a hash of the native
+sources, so repeat runs spend the budget on TPU metrics, not on re-timing
+an unchanged host binary.
 
 Robustness: this image's sitecustomize registers a tunneled-TPU backend that
 can hang indefinitely if the tunnel is wedged, so the accelerator is probed in
@@ -31,10 +38,86 @@ import time
 
 PROBE_TIMEOUT_S = 120
 REPS = 3
+_T_START = time.monotonic()
+BUDGET_S = float(os.environ.get("PLUSS_BENCH_BUDGET_S", 1200))
+NATIVE_CACHE = ".bench/native_cache.json"
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def remaining_s() -> float:
+    """Seconds left of the global wall budget."""
+    return BUDGET_S - (time.monotonic() - _T_START)
+
+
+def budget_ok(label: str, est_s: float) -> bool:
+    """True if an aux step estimated at ``est_s`` fits the remaining budget."""
+    rem = remaining_s()
+    if est_s > rem:
+        log(f"bench: SKIP {label}: needs ~{est_s:.0f}s, "
+            f"{rem:.0f}s of {BUDGET_S:.0f}s budget left")
+        return False
+    return True
+
+
+def _native_src_hash() -> str:
+    """Hash of the native runtime sources — invalidates cached baselines."""
+    import hashlib
+
+    h = hashlib.sha256()
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "pluss", "cpp")
+    for fn in sorted(os.listdir(d)):
+        p = os.path.join(d, fn)
+        if os.path.isfile(p):
+            with open(p, "rb") as f:
+                h.update(fn.encode() + b"\0" + f.read())
+    return h.hexdigest()[:16]
+
+
+def cached_native(key: str, measure) -> dict | None:
+    """Native-baseline memo: ``measure()`` returns a JSON-able dict (must
+    hold at least ``{"s": seconds}``) that is cached on disk until the
+    native sources change.  The host binary's speed is a property of this
+    box + those sources — re-timing it every round only burns wall budget
+    (round 3 spent 300+ s re-measuring identical binaries)."""
+    try:
+        with open(NATIVE_CACHE) as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        cache = {}
+    src = _native_src_hash()
+    ent = cache.get(key)
+    if ent and ent.get("src") == src:
+        log(f"bench: native baseline {key}: {ent['s']:.3f}s (cached)")
+        return ent
+    ent = measure()
+    if ent is not None and ent.get("s"):
+        ent["src"] = src
+        cache[key] = ent
+        os.makedirs(".bench", exist_ok=True)
+        tmp = NATIVE_CACHE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=1)
+        os.replace(tmp, NATIVE_CACHE)
+    return ent
+
+
+def cached_native_s(key: str, measure_s, est_s: float = 120) -> float | None:
+    """Seconds-returning flavor of :func:`cached_native`, budget-gated: a
+    cold-cache measurement only runs if ``est_s`` fits the remaining wall
+    budget (a skipped baseline degrades the metric to vs_baseline=null —
+    never to a missing metric line)."""
+    def measure() -> dict | None:
+        if not budget_ok(f"native baseline {key}", est_s):
+            return None
+        s = measure_s()
+        return {"s": s} if s else None
+
+    ent = cached_native(key, measure)
+    return ent["s"] if ent else None
 
 
 def probe_accelerator() -> str | None:
@@ -183,12 +266,8 @@ def bench_trace_device(n_lines: int = 4_200_000) -> None:
     emit("trace_device_scan_refs_per_sec", reps * batch, dt, None)
 
 
-def bench_trace(n_refs: int) -> None:
-    """BASELINE config 5: dynamic trace replay at 1e9 refs, streamed from
-    disk (pluss.trace.replay_file) vs the native replay_trace on the same
-    addresses.  The trace file is generated once and cached in .bench/."""
-    from pluss import native, trace
-
+def ensure_trace(n_refs: int) -> str:
+    """Generate (once) and return the cached synthetic trace path."""
     os.makedirs(".bench", exist_ok=True)
     path = f".bench/trace_{n_refs}.bin"
     if not (os.path.exists(path) and os.path.getsize(path) == 8 * n_refs):
@@ -196,6 +275,99 @@ def bench_trace(n_refs: int) -> None:
         t0 = time.perf_counter()
         synth_trace(path, n_refs)
         log(f"bench: trace generated in {time.perf_counter() - t0:.1f}s")
+    return path
+
+
+def native_trace_rate(path: str) -> float | None:
+    """Native replay rate (refs/s), measured once on a 2^27-ref prefix and
+    cached — the baseline for BOTH trace metrics.  Native replay is linear
+    in refs (hashmap walk), so the rate scales to any prefix."""
+    from pluss import native, trace
+
+    def measure() -> dict | None:
+        # cold-cache cost: ~1 GB prefix load + ~30 s native walk — gate it
+        # on the global budget so a late cache miss can't starve the
+        # metrics that were admitted under small estimates
+        if not budget_ok("native trace rate (one-time)", 90):
+            return None
+        try:
+            if not native.available(autobuild=True):
+                return None
+            n = min(1 << 27, os.path.getsize(path) // 8)
+            addrs = trace.load_trace(path)[:n]
+            t0 = time.perf_counter()
+            native.replay(addrs)
+            return {"s": time.perf_counter() - t0, "refs": n}
+        except (RuntimeError, MemoryError) as e:
+            log(f"bench: native trace baseline unavailable: {e}")
+            return None
+
+    # keyed by trace file: the native rate depends on the working-set size
+    # (hashmap cache behavior), so rates from different traces don't mix
+    ent = cached_native(f"trace_replay_rate:{os.path.basename(path)}",
+                        measure)
+    return ent["refs"] / ent["s"] if ent else None
+
+
+def bench_trace_resident(n_refs: int) -> None:
+    """Staged-resident replay (VERDICT r3 task 3b): upload the packed trace
+    to HBM once, replay from device memory — upload and replay reported
+    separately, so the metric is independent of tunnel h2d weather.  The
+    packed-id file is produced once by trace.pack_file and cached."""
+    import json as _json
+
+    from pluss import trace
+
+    path = ensure_trace(n_refs)
+    packed = f".bench/trace_{n_refs}.pack"
+    sidecar = packed + ".json"
+    if os.path.exists(packed) and os.path.exists(sidecar):
+        with open(sidecar) as f:
+            meta = _json.load(f)
+    else:
+        if not budget_ok("trace pack_file (one-time)", 420):
+            return
+        log(f"bench: packing trace ids (one-time) at {packed}")
+        t0 = time.perf_counter()
+        meta = trace.pack_file(path, packed)
+        log(f"bench: packed in {time.perf_counter() - t0:.1f}s "
+            f"({meta['n_lines']} line slots)")
+    # staging budget: leave room for the e2e metric after us
+    upload_budget = max(30.0, min(remaining_s() * 0.5, 300.0))
+    resident, n_run, stats = trace.stage_resident(
+        packed, meta, upload_budget_s=upload_budget)
+    if n_run == 0:
+        log("bench: resident staging yielded no refs; skipping")
+        return
+    mb = stats["upload_bytes"] / 1e6
+    log(f"bench: staged {n_run} refs ({mb:.0f} MB) in "
+        f"{stats['upload_s']:.1f}s ({mb / stats['upload_s']:.1f} MB/s)")
+    # warmup replay (compiles; also first touch of the resident array),
+    # then ONE timed replay at a shifted clock origin — histogram-invariant
+    # but a distinct input, so the tunnel's content memo can't serve it
+    trace.replay_staged(resident, meta["n_lines"], n_run)
+    t0 = time.perf_counter()
+    rep = trace.replay_staged(resident, meta["n_lines"], n_run,
+                              clock0=1 << 30)
+    replay_s = time.perf_counter() - t0
+    rate = native_trace_rate(path)
+    base_s = n_run / rate if rate else None
+    assert int(rep.hist.sum()) == n_run  # BEFORE emit: a corrupt replay
+    # must never leave a metric line in the round record
+    emit(f"trace{n_refs}_resident_refs_per_sec", n_run, replay_s, base_s,
+         refs_replayed=n_run, refs_requested=n_refs,
+         shrunk=bool(n_run != n_refs),
+         upload_s=round(stats["upload_s"], 1),
+         upload_mb_s=round(mb / stats["upload_s"], 2))
+
+
+def bench_trace(n_refs: int) -> None:
+    """BASELINE config 5: dynamic trace replay at 1e9 refs, streamed from
+    disk (pluss.trace.replay_file) vs the native replay_trace on the same
+    addresses.  The trace file is generated once and cached in .bench/."""
+    from pluss import trace
+
+    path = ensure_trace(n_refs)
     # warmup on a short prefix: the prefix discovers the same working set,
     # so the full run below hits the jit cache at the same table shape.
     # (One full timed run, not best-of-N: the tunneled TPU's throughput
@@ -212,7 +384,8 @@ def bench_trace(n_refs: int) -> None:
     # the warmup and shrink the replayed prefix to a wall-clock budget —
     # the metric VALUE is a rate either way, and the name carries the
     # actual ref count so a shrunk run is never mistaken for the full one.
-    budget_s = float(os.environ.get("PLUSS_BENCH_TRACE_BUDGET_S", 900))
+    budget_s = min(float(os.environ.get("PLUSS_BENCH_TRACE_BUDGET_S", 900)),
+                   max(remaining_s() - 30, 60))  # leave margin to finish
     rate = warm.total_count / max(warm_s, 1e-9)
     n_run = n_refs
     if n_refs / rate > budget_s:
@@ -231,21 +404,16 @@ def bench_trace(n_refs: int) -> None:
     rep = trace.replay_file(path, limit_refs=n_run)
     best_s = time.perf_counter() - t0
     log(f"bench: {rep.total_count} refs over {rep.n_lines} line slots")
-    base_s = None
-    try:
-        if native.available(autobuild=True):
-            # host RAM; excluded from timing.  Same prefix as the device run
-            addrs = trace.load_trace(path)[:n_run]
-            t0 = time.perf_counter()
-            native.replay(addrs)
-            base_s = time.perf_counter() - t0
-    except (RuntimeError, MemoryError) as e:
-        log(f"bench: native trace baseline unavailable: {e}")
+    # native replay is linear in refs, so one measured (refs, seconds) pair
+    # scales to whatever prefix the feed budget allowed this round
+    rate = native_trace_rate(path)
+    base_s = n_run / rate if rate else None
     # the metric NAME keeps the REQUESTED size so round-to-round tracking
-    # stays keyed on one string; check refs_replayed (and the stderr log)
-    # to see whether a slow feed shrank the actually-replayed prefix
+    # stays keyed on one string; refs_requested + shrunk let downstream
+    # tooling filter budget-shrunk runs without parsing stderr
     emit(f"trace{n_refs}_replay_refs_per_sec", n_run, best_s, base_s,
-         refs_replayed=n_run)
+         refs_replayed=n_run, refs_requested=n_refs,
+         shrunk=bool(n_run != n_refs))
 
 
 def main() -> int:
@@ -283,52 +451,76 @@ def main() -> int:
             return res
         return step
 
-    if plat is not None:
-        # mixed-coefficient metric (VERDICT r1 weak #1 / r2 task 1): syrk's
-        # A refs are template-ineligible by construction; since round 3
-        # they ride the interleave overlay (pluss.overlay) instead of the
-        # device sort — same metric name as r01/r02 for comparability
-        n_syrk = 1024
-        best_s, res = timed_reps(step_of(syrk(n_syrk)), 2, f"syrk{n_syrk}")
-        emit(f"syrk{n_syrk}_sortpath_refs_per_sec", res.max_iteration_count,
-             best_s, native_spec_s(syrk(n_syrk)))
+    if plat is None:
+        best_s, res = timed_reps(step_of(gemm(128)), REPS, "gemm128")
+        emit("gemm128_sampler_refs_per_sec_cpu_fallback",
+             res.max_iteration_count, best_s,
+             cached_native_s("gemm128", lambda: native_baseline_s(128)))
+        return 0
 
-        # triangular metric (VERDICT r2 task 4): bounded inner loops take
-        # the clock-table + device-sort path — no template, no overlay.
-        # seq backend: the 4-thread vmap of 16.8M-entry triangular sort
-        # windows exceeds what the device survives at n=1024 (worker
-        # crash); one thread at a time is the honest runnable config.
-        from pluss.models import syrk_triangular
+    # headline FIRST (round 3's record has rc=124 with this metric still
+    # queued): BASELINE.json config 2, GEMM 1024^3 (4.3e9 refs).  The
+    # native baseline is budget-gated inside cached_native_s, so a cold
+    # cache can degrade vs_baseline to null but can never block the line.
+    best_s, res = timed_reps(step_of(gemm(1024)), REPS, "gemm1024")
+    emit("gemm1024_sampler_refs_per_sec", res.max_iteration_count, best_s,
+         cached_native_s("gemm1024", lambda: native_baseline_s(1024)))
 
+    def native_s_of(key, spec):
+        return cached_native_s(key, lambda: native_spec_s(spec))
+
+    # mixed-coefficient metric (VERDICT r1 weak #1 / r2 task 1): syrk's
+    # A refs are template-ineligible by construction; since round 3
+    # they ride the interleave overlay (pluss.overlay) instead of the
+    # device sort — same metric name as r01/r02 for comparability
+    if budget_ok("syrk1024", 90):
+        try:
+            n_syrk = 1024
+            best_s, res = timed_reps(step_of(syrk(n_syrk)), 2,
+                                     f"syrk{n_syrk}")
+            emit(f"syrk{n_syrk}_sortpath_refs_per_sec",
+                 res.max_iteration_count, best_s,
+                 native_s_of("syrk1024", syrk(n_syrk)))
+        except Exception as e:  # never let an aux metric sink the record
+            log(f"bench: syrk metric failed: {e}")
+
+    # triangular metric (VERDICT r2 task 4): bounded inner loops take the
+    # clock-table + device-sort path — no template, no overlay.
+    from pluss.models import syrk_triangular
+
+    if budget_ok("syrktri1024", 180):
         try:
             spec_tri = syrk_triangular(1024)
+            # seq backend until the dispatch-sliced vmap path lands: the
+            # 4-way-concurrent 16.8M-entry triangular windows exceed what
+            # the tunneled worker survives at n=1024 (r3 isolation runs)
             best_s, res = timed_reps(step_of(spec_tri, backend="seq"), 1,
-                                     "syrktri1024(seq)")
+                                     "syrktri1024")
             emit("syrktri1024_sortpath_refs_per_sec",
-                 res.max_iteration_count, best_s, native_spec_s(spec_tri))
-        except Exception as e:  # never let an aux metric sink the headline
+                 res.max_iteration_count, best_s,
+                 native_s_of("syrktri1024", spec_tri))
+        except Exception as e:
             log(f"bench: triangular metric failed: {e}")
 
-        # trace-replay metrics (VERDICT r1 weak #4 / BASELINE config 5):
-        # device-only scan rate first (robust), then 1e9 refs streamed from
-        # disk end-to-end (gated by the tunnel's h2d feed)
+    # trace-replay metrics (VERDICT r1 weak #4 / BASELINE config 5):
+    # device-only scan rate first (robust), then 1e9 refs streamed from
+    # disk end-to-end (gated by the tunnel's h2d feed)
+    if budget_ok("trace_device", 60):
         try:
             bench_trace_device()
         except Exception as e:
             log(f"bench: trace device metric failed: {e}")
+    trace_refs = int(os.environ.get("PLUSS_BENCH_TRACE_REFS", 1_000_000_000))
+    if budget_ok("trace_resident", 120):
         try:
-            bench_trace(int(os.environ.get("PLUSS_BENCH_TRACE_REFS",
-                                           1_000_000_000)))
-        except Exception as e:  # never let the aux metric sink the headline
+            bench_trace_resident(trace_refs)
+        except Exception as e:
+            log(f"bench: trace resident metric failed: {e}")
+    if budget_ok("trace_e2e", 150):  # bench_trace self-shrinks to the budget
+        try:
+            bench_trace(trace_refs)
+        except Exception as e:
             log(f"bench: trace metric failed: {e}")
-
-        # headline (LAST): BASELINE.json config 2, GEMM 1024^3 (4.3e9 refs)
-        n, metric = 1024, "gemm1024_sampler_refs_per_sec"
-    else:
-        n, metric = 128, "gemm128_sampler_refs_per_sec_cpu_fallback"
-
-    best_s, res = timed_reps(step_of(gemm(n)), REPS, f"gemm{n}")
-    emit(metric, res.max_iteration_count, best_s, native_baseline_s(n))
     return 0
 
 

@@ -164,6 +164,82 @@ def test_replay_file_text_fallback(tmp_path):
         trace.replay_file(str(pt), fmt="bogus")
 
 
+def test_replay_u16_packed_feed():
+    # working set under 2^16 lines takes the u16 wire format (halves the
+    # feed vs int32); histogram must be identical to the oracle
+    rng = np.random.default_rng(5)
+    addrs = rng.integers(0, 1 << 12, 3000) * 64
+    res = trace.replay(addrs, window=1 << 9)
+    assert res.n_lines <= 1 << 16
+    assert res.histogram() == oracle_replay(addrs)
+
+
+def test_pack_ids_format_selection():
+    ids = np.arange(10, dtype=np.int32)
+    assert trace._pack_ids(ids, 1 << 10).dtype == np.uint16
+    assert trace._pack_ids(ids, 1 << 20).dtype == np.uint8      # [n,3]
+    assert trace._pack_ids(ids, 1 << 20).shape == (10, 3)
+    assert trace._pack_ids(ids, 1 << 25).dtype == np.int32
+
+
+def test_replay_file_u16_to_u24_growth(tmp_path):
+    # the table crosses 2^16 mid-stream: early batches ship u16, later ones
+    # 24-bit packed; the accumulated histogram must not care
+    n_hot, n = 200, 4096
+    rng = np.random.default_rng(19)
+    first = rng.integers(0, n_hot, n // 2, dtype=np.int64)
+    # second half touches a wide range -> compactor grows past 2^16
+    second = rng.integers(0, 1 << 18, n - n // 2, dtype=np.int64)
+    addrs = np.concatenate([first, second]) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    res = trace.replay_file(str(p), window=1 << 9, initial_capacity=64)
+    assert res.n_lines > 1 << 16
+    assert res.histogram() == oracle_replay(addrs)
+
+
+def test_pack_file_and_replay_resident(tmp_path):
+    # pack once, stage to (virtual) device memory, replay resident: must be
+    # bit-identical to the streamed replay, incl. a ragged final batch
+    rng = np.random.default_rng(29)
+    window = 1 << 9
+    n = 8 * window * 3 - 101
+    addrs = rng.integers(0, 1 << 12, n, dtype=np.int64) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    packed = str(tmp_path / "t.pack")
+    meta = trace.pack_file(str(p), packed, window=window)
+    assert meta["n"] == n and meta["fmt"] == "u24"
+    stats = {}
+    res = trace.replay_resident(packed, meta, window=window, stats=stats)
+    ref = trace.replay(addrs, window=window)
+    assert res.total_count == n == stats["refs"]
+    np.testing.assert_array_equal(res.hist, ref.hist)
+    assert stats["upload_bytes"] >= n * 3 and stats["replay_s"] > 0
+    # clock0 shift is histogram-invariant (the tunnel-memo defeater)
+    res2 = trace.replay_resident(packed, meta, window=window,
+                                 clock0=8 * window * 3)
+    np.testing.assert_array_equal(res2.hist, ref.hist)
+
+
+def test_replay_resident_limit_refs(tmp_path):
+    rng = np.random.default_rng(31)
+    window = 1 << 9
+    n = 8 * window * 2
+    addrs = rng.integers(0, 1 << 11, n, dtype=np.int64) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    packed = str(tmp_path / "t.pack")
+    meta = trace.pack_file(str(p), packed, window=window)
+    lim = 8 * window  # one full batch
+    res = trace.replay_resident(packed, meta, window=window, limit_refs=lim)
+    ref = trace.replay(addrs[:lim], window=window)
+    assert res.total_count == lim
+    # same prefix, but resident ids come from the WHOLE trace's compaction;
+    # with a dense-range table the ids agree, so histograms match exactly
+    np.testing.assert_array_equal(res.hist, ref.hist)
+
+
 def test_shard_replay_file_matches_replay_file(tmp_path):
     """Disk-streamed sharded replay == single-device streamed replay, on a
     trace LARGER than any single slice buffer (VERDICT r2 task 5): 8
