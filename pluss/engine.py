@@ -929,17 +929,54 @@ def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
     return last_pos, hist_delta, ev, (key_s, pos_s, span_s)
 
 
-def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
-    """Full per-thread pipeline: scan windows -> sort -> histogram.  vmapped."""
+def _segments_of(np_: NestPlan) -> list[tuple[bool, list[int], tuple | None]]:
+    """Window segments of one nest, in processing order.
+
+    Each entry is ``(is_ultra, window_ids, bucket_refs)``: windows processed
+    in order as (ultra | sort) runs — a window takes the static-template
+    path only when it is clean for EVERY thread (vmap runs threads in
+    lockstep).  Triangular nests instead split into size buckets (all sort
+    path, per-bucket static trips).  Shared by the one-dispatch pipeline
+    and the dispatch-sliced runner, whose slice indices must agree.
+    """
+    if np_.tri_buckets is not None:
+        return [(False, list(ws), brefs) for ws, brefs in np_.tri_buckets]
+    ultra_w = np_.ultra_windows()
+    segments: list[tuple[bool, list[int], tuple | None]] = []
+    for w in range(np_.n_windows):
+        if segments and segments[-1][0] == bool(ultra_w[w]):
+            segments[-1][1].append(w)
+        else:
+            segments.append((bool(ultra_w[w]), [w], None))
+    return segments
+
+
+def _thread_pipeline(tid, pl: StreamPlan, share_cap: int, carry=None,
+                     only=None):
+    """Full per-thread pipeline: scan windows -> sort -> histogram.  vmapped.
+
+    ``carry``: optional ``(last_pos, hist)`` to resume from (defaults to a
+    fresh cold table) — the dispatch-sliced runner threads it between
+    executions.  ``only``: optional ``(nest_idx, segment_idx, w_ids)``
+    processing just that segment's windows ``w_ids`` (a traced int32 array,
+    so one executable serves every same-length slice of the segment).
+    Returns ``((last_pos, hist), share_ys)`` — per processed nest in full
+    mode, the single slice's ys in ``only`` mode.
+    """
     cfg = pl.cfg
     bases = pl.spec.line_bases(cfg)
     n_lines = pl.spec.total_lines(cfg)
     pdt = jnp.dtype(pl.pos_dtype)
-    last_pos = jnp.full((n_lines,), -1, pdt)
-    hist = jnp.zeros((NBINS,), pdt)
+    if carry is None:
+        last_pos = jnp.full((n_lines,), -1, pdt)
+        hist = jnp.zeros((NBINS,), pdt)
+    else:
+        last_pos, hist = carry
     nest_base = jnp.asarray(pl.nest_base.astype(pl.pos_dtype))
     share_ys = []
     for ni, np_ in enumerate(pl.nests):
+        if only is not None and ni != only[0]:
+            continue
         owned_row = jnp.asarray(np_.owned)[tid]
         nb = nest_base[ni, tid]
         win_shift = np_.window_rounds * cfg.chunk_size * np_.body
@@ -1077,50 +1114,33 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int):
         else:
             ultra_step = None
 
-        # windows processed in order as (ultra | sort) segments: a window
-        # takes the static-template path only when it is clean for EVERY
-        # thread (vmap runs threads in lockstep).  Triangular nests instead
-        # split into size buckets (all sort path, per-bucket static trips)
-        ultra_w = np_.ultra_windows()
-        segments: list[tuple[bool, list[int], tuple | None]] = []
-        if np_.tri_buckets is not None:
-            segments = [(False, list(ws), brefs)
-                        for ws, brefs in np_.tri_buckets]
-        else:
-            for w in range(np_.n_windows):
-                if segments and segments[-1][0] == bool(ultra_w[w]):
-                    segments[-1][1].append(w)
-                else:
-                    segments.append((bool(ultra_w[w]), [w], None))
-
+        segments = _segments_of(np_)
         ys_parts = []
-        for is_ultra, w_list, brefs in segments:
+        for si, (is_ultra, w_list, brefs) in enumerate(segments):
+            if only is not None and si != only[1]:
+                continue
             if is_ultra:
                 body = ultra_step
             elif brefs is not None:
                 body = functools.partial(sort_step, refs=brefs)
             else:
                 body = sort_step
-            if len(w_list) == 1:
-                (last_pos, hist), ys = body(
-                    (last_pos, hist), jnp.int32(w_list[0])
-                )
-                ys = jax.tree.map(lambda a: a[None], ys)
-            else:
-                (last_pos, hist), ys = jax.lax.scan(
-                    body, (last_pos, hist),
-                    jnp.asarray(w_list, jnp.int32),
-                )
+            xs = only[2] if only is not None else \
+                jnp.asarray(w_list, jnp.int32)
+            (last_pos, hist), ys = jax.lax.scan(body, (last_pos, hist), xs)
             ys_parts.append(ys)
+        if only is not None:
+            share_ys.extend(ys_parts)   # exactly the one selected slice
+            continue
         ys = (
             ys_parts[0]
             if len(ys_parts) == 1
             else jax.tree.map(
-                lambda *xs: jnp.concatenate(xs, axis=0), *ys_parts
+                lambda *xs_: jnp.concatenate(xs_, axis=0), *ys_parts
             )
         )
         share_ys.append(ys)
-    return hist, share_ys
+    return (last_pos, hist), share_ys
 
 
 def _thread_pipeline_packed(tid, pl: StreamPlan, share_cap: int):
@@ -1130,7 +1150,7 @@ def _thread_pipeline_packed(tid, pl: StreamPlan, share_cap: int):
     tunneled TPU), so the histogram and all per-window share outputs are
     concatenated on device; :func:`_unpack` slices them back on the host.
     """
-    hist, share_ys = _thread_pipeline(tid, pl, share_cap)
+    (_, hist), share_ys = _thread_pipeline(tid, pl, share_cap)
     pdt = jnp.dtype(pl.pos_dtype)
     parts = [hist.astype(pdt).ravel()]
     for ys in share_ys:   # 3 arrays per nest, or 6 with overlay subtractions
@@ -1176,6 +1196,159 @@ def _normalize_thread_batch(thread_batch: int | None,
     if thread_batch < 1:
         raise ValueError(f"thread_batch must be >= 1, got {thread_batch}")
     return None if thread_batch >= cfg.thread_num else thread_batch
+
+
+def _segment_entries_per_window(np_: NestPlan, cfg: SamplerConfig,
+                                n_lines: int, is_ultra: bool,
+                                brefs) -> int:
+    """Sorted entries one window of this segment puts on the device — the
+    unit of the dispatch-time estimate.  Ultra windows sort only the
+    template-ineligible remainder (the template/overlay part is O(lines),
+    counted as the ghost term)."""
+    refs = np_.var_refs_novl if is_ultra else (brefs or np_.refs)
+    per_iter = sum(int(np.prod(fr.trips[1:], dtype=np.int64)) for fr in refs)
+    return np_.window_rounds * cfg.chunk_size * per_iter + n_lines
+
+
+def _dispatch_entry_budget() -> int:
+    """Sorted entries per sliced dispatch (across all concurrent threads):
+    sized so one dispatch stays well under the tunneled worker's
+    execution-time ceiling (~90 s observed; r3 killed every syrk_tri-1024
+    single-executable variant)."""
+    return int(os.environ.get("PLUSS_MAX_DISPATCH_ENTRIES", 1 << 28))
+
+
+def _slice_fn(pl: StreamPlan, share_cap: int, ni: int, si: int,
+              slice_len: int, thread_batch: int | None):
+    # the executable cache lives ON the plan object (a frozen dataclass, so
+    # via object.__setattr__): the jitted fns close over ``pl``, which in a
+    # module-level WeakKeyDictionary would make the value strongly reference
+    # its own key and keep every plan + executable alive forever; as a plain
+    # attribute it is just a collectable cycle whose lifetime follows the
+    # plan's (_plan_cached's lru eviction frees both).
+    # Keyed by (nest, segment, slice_len, thread_batch, backend) — w_ids are
+    # a traced argument, so every same-length slice of a segment reuses one
+    # executable.
+    cache = getattr(pl, "_slice_fns", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(pl, "_slice_fns", cache)
+    key = (ni, si, slice_len, thread_batch, jax.default_backend())
+    if key in cache:
+        return cache[key]
+    pdt = jnp.dtype(pl.pos_dtype)
+
+    def f(tids, last_pos, hist, w_ids):
+        def g(tid, lp_t, hi_t):
+            (lp2, hi2), ys_list = _thread_pipeline(
+                tid, pl, share_cap, carry=(lp_t, hi_t),
+                only=(ni, si, w_ids))
+            flat = jnp.concatenate(
+                [a.astype(pdt).ravel() for a in ys_list[0]])
+            return lp2, hi2, flat
+
+        if thread_batch:
+            return jax.lax.map(lambda a: g(*a), (tids, last_pos, hist),
+                               batch_size=thread_batch)
+        return jax.vmap(g)(tids, last_pos, hist)
+
+    # donate the carries so the [T, n_lines] table stays in place on device
+    # across dispatches (CPU backend: donation unsupported, would warn)
+    donate = (1, 2) if jax.default_backend() != "cpu" else ()
+    fn = jax.jit(f, donate_argnums=donate)
+    cache[key] = fn
+    return fn
+
+
+@functools.lru_cache(maxsize=32)
+def _plan_cached(spec: LoopNestSpec, cfg: SamplerConfig, assignment,
+                 start_point, window_accesses,
+                 sort_concurrency) -> StreamPlan:
+    """Shared plan memo for the sliced runner (compiled() memoizes its own
+    plan inside its cache entry)."""
+    return plan(spec, cfg, assignment, start_point, window_accesses,
+                sort_concurrency=sort_concurrency)
+
+
+def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
+               share_cap: int = SHARE_CAP, assignment=None, start_point=None,
+               window_accesses=None, thread_batch: int | None = None,
+               max_dispatch_entries: int | None = None) -> SamplerResult:
+    """Dispatch-sliced sampler run: the window stream executes as MANY short
+    device dispatches instead of one monolithic executable.
+
+    The carries (``last_pos`` [T, n_lines] and the histogram) thread
+    through the dispatches donated-in-place; per-slice share outputs stay
+    on device (futures) until one final fetch, so dispatch latency
+    pipelines behind device compute even over the tunneled backend.  This
+    is what lets the triangular workloads run with vmap thread concurrency
+    under this image's per-execution kill ceiling (~90 s): r3's
+    single-executable attempts (full vmap, thread_batch=2, even seq-length
+    tb=1) all died on syrk_tri-1024 (PARITY.md r3 isolation runs).
+    Bit-identical to :func:`run` — the slices replay the exact same window
+    sequence against the same carries.
+    """
+    if assignment is not None:
+        assignment = tuple(
+            tuple(a) if a is not None else None for a in assignment
+        )
+    thread_batch = _normalize_thread_batch(thread_batch, cfg)
+    # plan with sort_concurrency=1: the guard only needs ONE window to fit
+    # (slicing owns the time ceiling, the caller/_auto_dispatch owns the
+    # concurrency choice), and this keeps the plan object — and its slice
+    # executables — shared with run()'s auto-dispatch decision plan
+    pl = _plan_cached(spec, cfg, assignment, start_point, window_accesses, 1)
+    T = cfg.thread_num
+    n_lines = spec.total_lines(cfg)
+    pdt = np.dtype(pl.pos_dtype)
+    budget = max_dispatch_entries or _dispatch_entry_budget()
+    conc = thread_batch or T
+
+    tids = jnp.arange(T, dtype=jnp.int32)
+    last_pos = jnp.full((T, n_lines), -1, pdt)
+    hist = jnp.zeros((T, NBINS), pdt)
+    parts: list[list[tuple[int, object]]] = [[] for _ in pl.nests]
+    for ni, np_ in enumerate(pl.nests):
+        for si, (is_ultra, w_list, brefs) in enumerate(_segments_of(np_)):
+            epw = _segment_entries_per_window(np_, cfg, n_lines, is_ultra,
+                                              brefs)
+            wpd = max(1, min(len(w_list), budget // max(1, epw * conc)))
+            for lo in range(0, len(w_list), wpd):
+                sub = w_list[lo:lo + wpd]
+                fn = _slice_fn(pl, share_cap, ni, si, len(sub),
+                               thread_batch)
+                last_pos, hist, flat = fn(
+                    tids, last_pos, hist, jnp.asarray(sub, jnp.int32))
+                parts[ni].append((len(sub), flat))
+
+    hist_np = np.asarray(hist)
+    share_ys = []
+    for ni, np_ in enumerate(pl.nests):
+        triples = 2 if np_.overlays else 1
+        acc = None
+        for L, flat in parts[ni]:
+            ys = _unpack_slice(np.asarray(flat), L, share_cap, triples, T)
+            acc = ys if acc is None else [
+                np.concatenate([a, b], axis=1) for a, b in zip(acc, ys)]
+        share_ys.append(tuple(acc))
+    return _finalize(pl, hist_np, share_ys, share_cap, cfg)
+
+
+def _unpack_slice(flat: np.ndarray, L: int, cap: int, triples: int,
+                  T: int) -> list[np.ndarray]:
+    """Host-side inverse of one slice's packed ys: per triple
+    (sv [T, L, cap], sc [T, L, cap], snu [T, L])."""
+    out = []
+    off = 0
+    for _ in range(triples):
+        out.append(flat[:, off:off + L * cap].reshape(T, L, cap))
+        off += L * cap
+        out.append(flat[:, off:off + L * cap].reshape(T, L, cap))
+        off += L * cap
+        out.append(flat[:, off:off + L].reshape(T, L))
+        off += L
+    assert off == flat.shape[1]
+    return out
 
 
 @functools.lru_cache(maxsize=64)
@@ -1351,6 +1524,59 @@ def overlay_static_share(share_raw: list[dict], pl: StreamPlan) -> None:
                     d[v] = d.get(v, 0) - c
 
 
+def _auto_dispatch(pl: StreamPlan, cfg: SamplerConfig,
+                   thread_batch: int | None):
+    """Decide how to execute a plan without crashing the device worker.
+
+    Returns ``None`` for the default single-executable vmap path, or
+    ``(thread_batch, reason)`` for the dispatch-sliced path.  Two ceilings
+    (both env-tunable, measured on this image's tunneled TPU, r3):
+
+    - execution time: the worker kills any single execution around ~90 s;
+      estimated as total sorted entries (all threads) over
+      ``PLUSS_DISPATCH_ENTRY_RATE`` (default 5e7/s — conservative vs the
+      ~1e8/s measured on syrk_tri-1024) against ``PLUSS_MAX_DISPATCH_S``
+      (default 30).  Over the ceiling -> sliced dispatches.
+    - memory: per-window sort bytes x concurrency against
+      ``PLUSS_MAX_SORT_WINDOW_BYTES`` (the plan guard's limit); the ladder
+      halves the thread concurrency until it fits (tb=1 = seq-equivalent,
+      the ladder's bottom rung — one window must fit, or plan() fails
+      fast as before).
+
+    Pure host math on the plan — unit-testable without a device.
+    """
+    T = cfg.thread_num
+    n_lines = pl.spec.total_lines(cfg)
+    rate = float(os.environ.get("PLUSS_DISPATCH_ENTRY_RATE", 5e7))
+    ceiling_s = float(os.environ.get("PLUSS_MAX_DISPATCH_S", 30))
+    limit = int(os.environ.get("PLUSS_MAX_SORT_WINDOW_BYTES", 8 << 30))
+    total_entries = 0
+    max_window_bytes = 0
+    for np_ in pl.nests:
+        for is_ultra, w_list, brefs in _segments_of(np_):
+            epw = _segment_entries_per_window(np_, cfg, n_lines, is_ultra,
+                                              brefs)
+            total_entries += epw * len(w_list) * T
+            refs = np_.var_refs_novl if is_ultra else (brefs or np_.refs)
+            if refs:
+                max_window_bytes = max(max_window_bytes, sort_window_bytes(
+                    np_, cfg, pl.pos_dtype, n_lines, refs))
+    conc = thread_batch or T
+    while conc > 1 and max_window_bytes * conc > limit:
+        conc = (conc + 1) // 2
+    est_s = total_entries / rate
+    if est_s <= ceiling_s and conc == (thread_batch or T):
+        return None
+    reasons = []
+    if est_s > ceiling_s:
+        reasons.append(f"estimated {est_s:.0f}s single-executable time "
+                       f"exceeds the {ceiling_s:.0f}s dispatch ceiling")
+    if conc != (thread_batch or T):
+        reasons.append(f"sort-window memory {max_window_bytes / 2**30:.2f}"
+                       f" GiB/window caps thread concurrency at {conc}")
+    return _normalize_thread_batch(conc, cfg), "; ".join(reasons)
+
+
 def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         share_cap: int = SHARE_CAP, assignment=None, start_point=None,
         window_accesses=None, backend: str = "vmap",
@@ -1361,16 +1587,44 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     (one thread at a time), mirroring the reference's backend trio; the
     device-sharded backend lives in :mod:`pluss.parallel`.
     ``thread_batch``: see :func:`compiled`.
+
+    The vmap backend degrades automatically instead of crashing the device
+    worker: an over-ceiling plan reroutes to :func:`run_sliced` (same
+    results, many short dispatches) with a thread concurrency that fits the
+    memory budget — see :func:`_auto_dispatch`.  Disable with
+    ``PLUSS_NO_AUTO_DISPATCH=1`` (or by picking a backend explicitly).
     """
     if assignment is not None:
         assignment = tuple(
             tuple(a) if a is not None else None for a in assignment
         )
+    if backend == "vmap" and not os.environ.get("PLUSS_NO_AUTO_DISPATCH"):
+        pl0 = _plan_cached(spec, cfg, assignment, start_point,
+                           window_accesses, 1)
+        decision = _auto_dispatch(pl0, cfg,
+                                  _normalize_thread_batch(thread_batch, cfg))
+        if decision is not None:
+            tb, reason = decision
+            import sys
+
+            print(f"engine: auto-sliced dispatch "
+                  f"(thread_batch={tb or cfg.thread_num}): {reason}",
+                  file=sys.stderr)
+            return run_sliced(spec, cfg, share_cap, assignment, start_point,
+                              window_accesses, tb)
     pl, f = compiled(spec, cfg, share_cap, assignment, start_point,
                      window_accesses, backend,
                      _normalize_thread_batch(thread_batch, cfg))
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
     hist, share_ys = _unpack(np.asarray(f(tids)), pl, share_cap)
+    return _finalize(pl, hist, share_ys, share_cap, cfg)
+
+
+def _finalize(pl: StreamPlan, hist: np.ndarray, share_ys,
+              share_cap: int, cfg: SamplerConfig) -> SamplerResult:
+    """Shared tail of :func:`run` / :func:`run_sliced`: merge the per-window
+    share outputs, add the host-side static share constants, settle overlay
+    subtractions, and box the result."""
     # share_ys: per nest (svals [T, NW, cap], scnts, snu [T, NW]), plus the
     # same triple of overlay SUBTRACTIONS for nests with overlays
     share_raw = merge_share_windows(

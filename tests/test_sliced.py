@@ -1,0 +1,117 @@
+"""Dispatch-sliced execution (engine.run_sliced) and the auto-degrade ladder.
+
+r3's syrk_tri-1024 killed the tunneled TPU worker under every
+single-executable multi-thread variant (VERDICT r3 weak #2/#4); the sliced
+runner splits the window stream into many short dispatches threading the
+``(last_pos, hist)`` carries through donated buffers, and ``engine.run``
+auto-reroutes over-ceiling plans to it.  Bit-equality with the one-dispatch
+path is the contract.
+"""
+
+import numpy as np
+import pytest
+
+from pluss import engine
+from pluss.config import DEFAULT, SamplerConfig
+from pluss.models import REGISTRY, gemm, syrk, syrk_triangular
+
+
+def assert_same(a, b):
+    assert a.max_iteration_count == b.max_iteration_count
+    np.testing.assert_array_equal(a.noshare_dense, b.noshare_dense)
+    assert a.share_list() == b.share_list()
+
+
+@pytest.mark.parametrize("model,n", [
+    ("gemm", 16),            # template/ultra path
+    ("gemm", 13),            # partial chunks: mixed ultra/sort segments
+    ("syrk", 16),            # overlay path (6-tuple ys slices)
+    ("syrk_tri", 13),        # triangular buckets + clock tables
+    ("trmm", 12),
+    ("mvt", 16),             # multi-nest: carries cross nests mid-slice
+])
+def test_run_sliced_matches_run(model, n):
+    spec = REGISTRY[model](n)
+    a = engine.run(spec)
+    b = engine.run_sliced(spec)
+    assert_same(a, b)
+
+
+def test_run_sliced_single_window_dispatches():
+    # budget of 1 entry: every window becomes its own dispatch, maximally
+    # exercising the carry threading and per-slice ys assembly
+    spec = syrk_triangular(12)
+    a = engine.run(spec)
+    b = engine.run_sliced(spec, max_dispatch_entries=1)
+    assert_same(a, b)
+
+
+def test_run_sliced_thread_batch():
+    spec = syrk_triangular(13)
+    a = engine.run(spec)
+    for tb in (1, 2, 3):
+        assert_same(a, engine.run_sliced(spec, thread_batch=tb))
+
+
+def test_run_sliced_small_windows():
+    # window_accesses=1 forces many tiny windows (multi-window segments)
+    spec = syrk_triangular(16)
+    cfg = SamplerConfig(cls=8)
+    a = engine.run(spec, cfg)
+    b = engine.run_sliced(spec, cfg, window_accesses=1,
+                          max_dispatch_entries=500)
+    assert_same(a, b)
+
+
+def test_run_sliced_dynamic_assignment_and_resume():
+    spec = gemm(16)
+    asg = ((0, 2, 1, 3),)
+    a = engine.run(spec, assignment=asg)
+    assert_same(a, engine.run_sliced(spec, assignment=asg))
+    b = engine.run(spec, start_point=8)
+    assert_same(b, engine.run_sliced(spec, start_point=8))
+
+
+def test_auto_dispatch_decision_over_budget(monkeypatch):
+    # a synthetic over-budget plan must pin the fallback DECISION (VERDICT
+    # r3 task 4): tiny entry rate -> any plan exceeds the time ceiling
+    monkeypatch.setenv("PLUSS_DISPATCH_ENTRY_RATE", "1")
+    monkeypatch.setenv("PLUSS_MAX_DISPATCH_S", "1")
+    pl = engine._plan_cached(gemm(16), DEFAULT, None, None, None, 1)
+    decision = engine._auto_dispatch(pl, DEFAULT, None)
+    assert decision is not None
+    tb, reason = decision
+    assert "dispatch ceiling" in reason
+
+
+def test_auto_dispatch_memory_ladder(monkeypatch):
+    # memory ceiling one window under the 4-thread requirement: the ladder
+    # must halve concurrency until it fits, never raise
+    pl = engine._plan_cached(syrk_triangular(16), DEFAULT, None, None,
+                             None, 1)
+    need = max(engine.sort_window_bytes(
+        np_, DEFAULT, pl.pos_dtype, pl.spec.total_lines(DEFAULT), refs)
+        for np_ in pl.nests
+        for refs in [np_.refs])
+    monkeypatch.setenv("PLUSS_MAX_SORT_WINDOW_BYTES", str(2 * need))
+    decision = engine._auto_dispatch(pl, DEFAULT, None)
+    assert decision is not None
+    tb, reason = decision
+    assert tb == 2 and "concurrency" in reason
+
+
+def test_auto_dispatch_small_plan_stays_single():
+    pl = engine._plan_cached(gemm(16), DEFAULT, None, None, None, 1)
+    assert engine._auto_dispatch(pl, DEFAULT, None) is None
+
+
+def test_run_autoroutes_over_budget_plan(monkeypatch):
+    # end-to-end: run() with default args on an "over-budget" plan must
+    # complete via the sliced path with identical results
+    spec = syrk(16)
+    want = engine.run(spec)
+    monkeypatch.setenv("PLUSS_DISPATCH_ENTRY_RATE", "1")
+    monkeypatch.setenv("PLUSS_MAX_DISPATCH_S", "1")
+    engine._plan_cached.cache_clear()
+    got = engine.run(spec)
+    assert_same(want, got)
