@@ -501,11 +501,10 @@ def main() -> int:
     if budget_ok("syrktri1024", 180):
         try:
             spec_tri = syrk_triangular(1024)
-            # seq backend until the dispatch-sliced vmap path lands: the
-            # 4-way-concurrent 16.8M-entry triangular windows exceed what
-            # the tunneled worker survives at n=1024 (r3 isolation runs)
-            best_s, res = timed_reps(step_of(spec_tri, backend="seq"), 1,
-                                     "syrktri1024")
+            # default backend: engine auto-reroutes this over-ceiling plan
+            # to the dispatch-sliced vmap path (r3's single-executable
+            # multi-thread variants all killed the tunneled worker)
+            best_s, res = timed_reps(step_of(spec_tri), 1, "syrktri1024")
             emit("syrktri1024_sortpath_refs_per_sec",
                  res.max_iteration_count, best_s,
                  native_s_of("syrktri1024", spec_tri))

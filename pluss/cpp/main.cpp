@@ -6,7 +6,9 @@
 #include <chrono>
 #include <cstdio>
 #include <cstring>
+#include <stdexcept>
 #include <string>
+#include <vector>
 
 #include "pluss_rt.hpp"
 
@@ -49,6 +51,34 @@ pluss::Spec gemm_spec(long long n, int ds, int cls) {
   for (int a = 0; a < 3; ++a)
     spec.array_lines.push_back((n * n * ds + cls - 1) / cls);
   return spec;
+}
+
+// on-disk spec format of pluss.native.write_spec_file: little-endian int64
+// [magic, n_arrays, elems..., n_tokens, tokens...] in the pluss_rt token
+// grammar — lets run.sh produce a native block for EVERY registry model
+// instead of only the hardwired GEMM.
+constexpr long long kSpecMagic = 0x53554C50;  // "PLUS"
+
+pluss::Spec load_spec_file(const char* path, const pluss::Config& cfg) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) throw std::runtime_error(std::string("cannot open ") + path);
+  std::vector<long long> words;
+  long long w;
+  while (std::fread(&w, sizeof(w), 1, f) == 1) words.push_back(w);
+  std::fclose(f);
+  if (words.size() < 3 || words[0] != kSpecMagic)
+    throw std::runtime_error("bad spec file (magic mismatch)");
+  // subtraction-sided bounds: "3 + n_arrays" would signed-overflow for a
+  // corrupt count near LLONG_MAX and bypass the check
+  long long n_arrays = words[1];
+  if (n_arrays < 0 || n_arrays > (long long)words.size() - 3)
+    throw std::runtime_error("truncated spec file (arrays)");
+  long long n_tokens = words[2 + n_arrays];
+  if (n_tokens < 0 ||
+      n_tokens != (long long)words.size() - 3 - n_arrays)
+    throw std::runtime_error("truncated spec file (tokens)");
+  return pluss::parse_spec(words.data() + 3 + n_arrays, n_tokens,
+                           words.data() + 2, (int)n_arrays, cfg.ds, cfg.cls);
 }
 
 void print_hist(const char* title, const Histogram& h) {
@@ -131,9 +161,27 @@ struct Timer {
 
 int main(int argc, char** argv) {
   std::string mode = argc > 1 ? argv[1] : "acc";
-  long long n = argc > 2 ? std::atoll(argv[2]) : 128;
   pluss::Config cfg;
-  pluss::Spec spec = gemm_spec(n, cfg.ds, cfg.cls);
+  pluss::Spec spec;
+  long long n = 128;
+  int argi = 3;  // first positional after mode+n (mrc path etc.)
+  if (argc > 3 && std::strcmp(argv[2], "--spec") == 0) {
+    // any registry model, serialized by pluss.native.write_spec_file
+    try {
+      spec = load_spec_file(argv[3], cfg);
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "%s\n", e.what());
+      return 1;
+    }
+    argi = 4;
+  } else if (argc > 2 && std::strcmp(argv[2], "--spec") == 0) {
+    std::fprintf(stderr, "usage: %s %s --spec <spec-file>\n", argv[0],
+                 mode.c_str());
+    return 2;
+  } else {
+    n = argc > 2 ? std::atoll(argv[2]) : 128;
+    spec = gemm_spec(n, cfg.ds, cfg.cls);
+  }
 
   if (mode == "acc") {
     Timer t;
@@ -161,7 +209,7 @@ int main(int argc, char** argv) {
   } else if (mode == "mrc") {
     // native twin of `python -m pluss.cli mrc` (the dormant titular
     // capability of the reference, live here)
-    const char* path = argc > 3 ? argv[3] : "mrc.csv";
+    const char* path = argc > argi ? argv[argi] : "mrc.csv";
     pluss::SampleResult res = pluss::run_sampler(spec, cfg);
     std::vector<double> mrc = pluss::aet_mrc(pluss::cri_distribute(res, cfg), cfg);
     pluss::write_mrc(mrc, path);

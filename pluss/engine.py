@@ -225,6 +225,11 @@ class NestPlan:
     #: enumeration+sort volume of early windows by up to ~2x.  Each entry is
     #: (window index tuple, per-bucket FlatRefs); None for rectangular nests
     tri_buckets: tuple | None = None
+    #: triangular nests only: [T, NW, NBINS] precomputed per-window event
+    #: histograms of the nest's row-private arrays (pluss.rowpriv) — their
+    #: refs are EXCLUDED from ``refs``/``tri_buckets`` and the device adds
+    #: one table row per window instead of sorting their stream
+    rpg_hist: np.ndarray | None = None
 
     def ultra_windows(self) -> np.ndarray:
         """[NW] bool: windows on the static-template path (clean for EVERY
@@ -578,7 +583,8 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
          n_windows: int | None = None,
          build_templates: bool = True,
          sort_concurrency: int | None = None,
-         build_overlays: bool = True) -> StreamPlan:
+         build_overlays: bool = True,
+         build_rowpriv: bool = True) -> StreamPlan:
     """Build the static stream plan.
 
     ``assignment``: optional per-nest chunk->thread maps (dynamic scheduling);
@@ -593,6 +599,9 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     brute-force verification — the shard backend passes False because its
     ultra windows sort the full ``var_refs`` (overlays are a vmap/seq-only
     optimization for now).
+    ``build_rowpriv``: False keeps row-private arrays on the sort path
+    (:mod:`pluss.rowpriv` is likewise a vmap/seq-only optimization: the
+    shard body and the subset sampler sort the full ref set).
     """
     T = cfg.thread_num
     geom = []  # (sched, refs, body, asg, owned, W, NW) per nest
@@ -714,6 +723,12 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                         w_hi = min(n_pref - 1, 2)
                         pairs = {(0, 0), (T - 1, min(1, w_hi)),
                                  (min(1, T - 1), w_hi)}
+                        # advisor r3: also check the LAST ultra-prefix
+                        # window (at a mid-range thread) when the brute
+                        # chain is cheap enough — an algebra defect that
+                        # only manifests at late windows must not ship
+                        if w_hi < n_pref - 1 <= 8:
+                            pairs.add((T // 2, n_pref - 1))
                         if verify_overlay(ov, cfg, sched, NW, pairs):
                             ovs.append(ov)
                             done.add(arr)
@@ -729,12 +744,22 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             # cache the template even when overlays are skipped (the shard
             # backend; resume runs build their own keyless plans)
             _plan_cache_put(cache_key, {"tpl": tpl, "overlays": None})
-        tri_buckets = _tri_buckets(refs, owned, sched, cfg, W, NW) \
+        refs_sort = refs
+        rpg_hist = None
+        if tri and build_rowpriv:
+            # row-private arrays: per-window histograms become a host
+            # table, their refs leave the device sort entirely
+            # (pluss.rowpriv; verified per group, falls back on mismatch)
+            from pluss import rowpriv
+
+            refs_sort, rpg_hist = rowpriv.build_rowpriv(
+                spec, ni, refs, cfg, sched, owned, W, NW)
+        tri_buckets = _tri_buckets(refs_sort, owned, sched, cfg, W, NW) \
             if tri else None
-        nests.append(NestPlan(sched, refs, body, owned, W, NW, tpl, clean,
-                              var_refs, overlays=overlays,
+        nests.append(NestPlan(sched, refs_sort, body, owned, W, NW, tpl,
+                              clean, var_refs, overlays=overlays,
                               var_refs_novl=var_novl, clock=clock,
-                              tri_buckets=tri_buckets))
+                              tri_buckets=tri_buckets, rpg_hist=rpg_hist))
         if not tri:  # triangular nests already counted via body_slot above
             for t in range(T):
                 for cid in owned[t]:
@@ -746,15 +771,31 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     nest_base[1:] = np.cumsum(acc[:-1], axis=0)
     total = int(acc.sum())
 
-    # fail loudly when a device SORT window cannot fit: windows never split
-    # a chunk-round, so a huge body on a templateless (ragged/triangular)
-    # nest would otherwise surface as an opaque XLA out-of-memory at
-    # compile time.  ``sort_concurrency``: how many such windows the caller
-    # materializes at once (the default vmap backend runs all T threads
-    # concurrently; the seq backend passes 1; the subset sampler re-checks
-    # with its own T x nsel fan-out).
+    check_sort_budget(nests, spec, cfg, pos_dtype, sort_concurrency)
+    return StreamPlan(
+        spec=spec,
+        cfg=cfg,
+        nests=tuple(nests),
+        iters_per_thread=iters,
+        nest_base=nest_base,
+        total_count=total,
+        pos_dtype=pos_dtype,
+    )
+
+
+def check_sort_budget(nests, spec: LoopNestSpec, cfg: SamplerConfig,
+                      pos_dtype, sort_concurrency: int | None) -> None:
+    """Fail loudly when a device SORT window cannot fit: windows never split
+    a chunk-round, so a huge body on a templateless (ragged/triangular)
+    nest would otherwise surface as an opaque XLA out-of-memory at
+    compile time.  ``sort_concurrency``: how many such windows the caller
+    materializes at once (the default vmap backend runs all T threads
+    concurrently; the seq backend passes 1; the subset sampler re-checks
+    with its own T x nsel fan-out).  Called by :func:`plan` and re-checked
+    by :func:`compiled` at the executable's true concurrency (the shared
+    plan memo always plans at concurrency 1)."""
     limit = int(os.environ.get("PLUSS_MAX_SORT_WINDOW_BYTES", 8 << 30))
-    conc = T if sort_concurrency is None else sort_concurrency
+    conc = cfg.thread_num if sort_concurrency is None else sort_concurrency
     n_lines = spec.total_lines(cfg)
     for ni, np_ in enumerate(nests):
         streams = []
@@ -784,15 +825,6 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                     "their static maximum because the enumeration shapes "
                     "are static — the buffers really are this large.)"
                 )
-    return StreamPlan(
-        spec=spec,
-        cfg=cfg,
-        nests=tuple(nests),
-        iters_per_thread=iters,
-        nest_base=nest_base,
-        total_count=total,
-        pos_dtype=pos_dtype,
-    )
 
 
 def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
@@ -984,6 +1016,10 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int, carry=None,
         var_ranges = _array_ranges(np_.var_refs_novl, pl.spec, cfg)
         clock_row = None if np_.clock is None else jnp.asarray(np_.clock)[tid]
         has_ovl = bool(np_.overlays)
+        # row-private arrays (pluss.rowpriv): their whole per-window event
+        # histogram is a plan-time table row; the device just adds it
+        rpg_row = None if np_.rpg_hist is None else \
+            jnp.asarray(np_.rpg_hist.astype(pl.pos_dtype))[tid]
 
         def zero_minus(vdt):
             return (jnp.zeros((share_cap,), vdt),
@@ -991,18 +1027,29 @@ def _thread_pipeline(tid, pl: StreamPlan, share_cap: int, carry=None,
 
         def sort_step(carry, w, np_=np_, owned_row=owned_row, nb=nb,
                       win_shift=win_shift, all_ranges=all_ranges,
-                      clock_row=clock_row, has_ovl=has_ovl, refs=None):
+                      clock_row=clock_row, has_ovl=has_ovl, rpg_row=rpg_row,
+                      refs=None):
             last_pos, hist = carry
-            last_pos, dh, ev, _ = _sort_window(
-                np_, refs or np_.refs, all_ranges, cfg, owned_row, w, nb,
-                bases, pl.spec.array_index, pdt, last_pos, win_shift,
-                clock_row=clock_row,
-            )
-            sv, sc, snu = share_unique(ev, share_cap)
+            if refs is None:
+                refs = np_.refs
+            if refs:
+                last_pos, dh, ev, _ = _sort_window(
+                    np_, refs, all_ranges, cfg, owned_row, w, nb,
+                    bases, pl.spec.array_index, pdt, last_pos, win_shift,
+                    clock_row=clock_row,
+                )
+                hist = hist + dh
+                sv, sc, snu = share_unique(ev, share_cap)
+            else:
+                # every array of the nest is row-private: the window is
+                # pure table lookup, no device sort at all
+                sv, sc, snu = zero_minus(pdt)
+            if rpg_row is not None:
+                hist = hist + rpg_row[w]
             ys = (sv, sc, snu)
             if has_ovl:   # overlay nests also report share SUBTRACTIONS
                 ys = ys + zero_minus(sv.dtype)
-            return (last_pos, hist + dh), ys
+            return (last_pos, hist), ys
 
         if np_.tpl is not None or np_.overlays:
             # an ultra window may carry a template, overlays, or both (a
@@ -1233,7 +1280,8 @@ def _slice_fn(pl: StreamPlan, share_cap: int, ni: int, si: int,
     if cache is None:
         cache = {}
         object.__setattr__(pl, "_slice_fns", cache)
-    key = (ni, si, slice_len, thread_batch, jax.default_backend())
+    key = (ni, si, slice_len, thread_batch, share_cap,
+           jax.default_backend())
     if key in cache:
         return cache[key]
     pdt = jnp.dtype(pl.pos_dtype)
@@ -1293,11 +1341,13 @@ def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             tuple(a) if a is not None else None for a in assignment
         )
     thread_batch = _normalize_thread_batch(thread_batch, cfg)
-    # plan with sort_concurrency=1: the guard only needs ONE window to fit
-    # (slicing owns the time ceiling, the caller/_auto_dispatch owns the
-    # concurrency choice), and this keeps the plan object — and its slice
-    # executables — shared with run()'s auto-dispatch decision plan
+    # plan with sort_concurrency=1 to keep the plan object — and its slice
+    # executables — shared with run()'s auto-dispatch decision plan; then
+    # re-check the memory guard at THIS run's true concurrency (slicing
+    # bounds dispatch time, not peak memory — direct callers must get the
+    # same loud fail as every other entry point)
     pl = _plan_cached(spec, cfg, assignment, start_point, window_accesses, 1)
+    check_sort_budget(pl.nests, spec, cfg, pl.pos_dtype, thread_batch)
     T = cfg.thread_num
     n_lines = spec.total_lines(cfg)
     pdt = np.dtype(pl.pos_dtype)
@@ -1351,7 +1401,6 @@ def _unpack_slice(flat: np.ndarray, L: int, cap: int, triples: int,
     return out
 
 
-@functools.lru_cache(maxsize=64)
 def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
              assignment=None, start_point=None, window_accesses=None,
              backend: str = "vmap", thread_batch: int | None = None):
@@ -1363,10 +1412,27 @@ def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
     sequential chunks of that size (``lax.map(..., batch_size=...)``) inside
     ONE executable — peak device memory scales with the chunk, not with T.
     Triangular nests' static-max sort windows need this at large sizes
-    (4-way-concurrent 16.8M-entry windows exceed what the device survives)."""
-    thread_batch = _normalize_thread_batch(thread_batch, cfg)
-    pl = plan(spec, cfg, assignment, start_point, window_accesses,
-              sort_concurrency=1 if backend == "seq" else thread_batch)
+    (4-way-concurrent 16.8M-entry windows exceed what the device survives).
+
+    Normalizes ``thread_batch`` BEFORE the memo lookup so equivalent values
+    (e.g. ``cfg.thread_num`` vs ``None``) share one compiled executable
+    (advisor r3)."""
+    return _compiled(spec, cfg, share_cap, assignment, start_point,
+                     window_accesses, backend,
+                     _normalize_thread_batch(thread_batch, cfg))
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
+              assignment, start_point, window_accesses,
+              backend: str, thread_batch: int | None):
+    # reuse the shared plan memo (planned at concurrency 1: plan content
+    # does not depend on it) so run()'s auto-dispatch decision plan is the
+    # SAME object — host planning (templates, buckets, rowpriv) runs once;
+    # the budget guard re-checks at this executable's true concurrency
+    pl = _plan_cached(spec, cfg, assignment, start_point, window_accesses, 1)
+    check_sort_budget(pl.nests, spec, cfg, pl.pos_dtype,
+                      1 if backend == "seq" else thread_batch)
 
     if backend == "vmap":
         def f(tids):
@@ -1382,6 +1448,18 @@ def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
             return jnp.stack([one(t) for t in tids])
         return pl, f
     raise ValueError(f"unknown backend {backend!r} (expected 'vmap' or 'seq')")
+
+
+def _clear_compiled_caches() -> None:
+    """Clear the executable memo AND the plan memo it feeds from: plan
+    content depends on env toggles (PLUSS_NO_OVERLAY, PLUSS_NO_ROWPRIV),
+    so clearing only the outer cache would hand back stale plans."""
+    _compiled.cache_clear()
+    _plan_cached.cache_clear()
+
+
+#: tests and tools clear the executable memo through the public name
+compiled.cache_clear = _clear_compiled_caches  # type: ignore[attr-defined]
 
 
 @dataclasses.dataclass

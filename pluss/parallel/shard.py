@@ -307,7 +307,7 @@ def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
     # budget guard must size that stream (and the overlay verification cost
     # would be pure waste here)
     pl = plan(spec, cfg, assignment, start_point, n_windows=D * S,
-              build_overlays=False)
+              build_overlays=False, build_rowpriv=False)
     f = jax.shard_map(
         lambda t: _shard_body(t, pl, share_cap, D, S),
         mesh=mesh,

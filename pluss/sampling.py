@@ -64,7 +64,7 @@ def _plan_cached(spec: LoopNestSpec, cfg: SamplerConfig,
     Templates are skipped: every sampled window walks the fresh-carry sort
     path, so the host-side template analysis would be pure waste."""
     return plan(spec, cfg, window_accesses=window_accesses,
-                build_templates=False)
+                build_templates=False, build_rowpriv=False)
 
 
 @functools.lru_cache(maxsize=64)

@@ -656,9 +656,14 @@ def stage_resident(packed_path: str, meta: dict,
                 jnp.asarray(raw.reshape(1, WINDOWS_PER_BATCH, window, 3)),
                 jnp.int32(b))
             staged = b + 1
-            if (upload_budget_s is not None and staged < n_batches
-                    and time.perf_counter() - t0 > upload_budget_s):
-                break
+            if upload_budget_s is not None and staged < n_batches \
+                    and staged % 16 == 0:
+                # transfers are ASYNC: without a periodic sync the loop
+                # finishes in milliseconds and the budget check never sees
+                # real elapsed time (observed: 427s staged past a 300s cap)
+                np.asarray(resident[0, 0, 0, :1])
+                if time.perf_counter() - t0 > upload_budget_s:
+                    break
     np.asarray(resident[0, 0, 0, :1])  # force staging completion (tiny d2h;
     # block_until_ready does not actually wait over the tunneled backend)
     upload_s = time.perf_counter() - t0

@@ -11,13 +11,32 @@ N=${2:-128}
 MODEL=${MODEL:-gemm}
 CLI_FLAGS=${PLUSS_CLI_FLAGS---cpu}
 
-if [ ! -f pluss/cpp/build/pluss_cpp ] && [ -d pluss/cpp ]; then
-  (cd pluss/cpp && make -s)
+# always try make (incremental, no-op when fresh): a stale prebuilt binary
+# would mis-parse the --spec flag used for non-gemm models.  A failed build
+# only warns — the Python CLI block below must still run and diagnose.
+if [ -d pluss/cpp ]; then
+  (cd pluss/cpp && make -s) || echo "run.sh: native build failed; skipping native block" >&2
 fi
-# the native binary hardwires the GEMM spec; other models compare via the
-# ctypes binding (tests/test_native.py)
-if [ -f pluss/cpp/build/pluss_cpp ] && [ "$MODEL" = gemm ]; then
-  ./pluss/cpp/build/pluss_cpp "$METHOD" "$N" >> output.txt
+if [ -f pluss/cpp/build/pluss_cpp ]; then
+  if [ "$MODEL" = gemm ]; then
+    ./pluss/cpp/build/pluss_cpp "$METHOD" "$N" >> output.txt
+  else
+    # any registry model: serialize the spec for the native binary; a
+    # serialization failure (bad MODEL etc.) skips the native block and
+    # lets the CLI below report the real error
+    SPEC_BIN=$(mktemp /tmp/pluss_spec_XXXX.bin)
+    # values pass via the environment, not textual interpolation: a quote
+    # or metacharacter in MODEL must fail cleanly, not edit the program
+    if MODEL="$MODEL" N="$N" SPEC_BIN="$SPEC_BIN" python -c "import os; \
+from pluss.models import REGISTRY; from pluss import native; \
+native.write_spec_file(REGISTRY[os.environ['MODEL']](int(os.environ['N'])), \
+os.environ['SPEC_BIN'])"; then
+      ./pluss/cpp/build/pluss_cpp "$METHOD" --spec "$SPEC_BIN" >> output.txt
+    else
+      echo "run.sh: spec serialization failed for MODEL=$MODEL; skipping native block" >&2
+    fi
+    rm -f "$SPEC_BIN"
+  fi
 fi
 
 python -m pluss.cli "$METHOD" --model "$MODEL" --n "$N" $CLI_FLAGS >> output.txt

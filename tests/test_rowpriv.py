@@ -1,0 +1,138 @@
+"""Row-private groups (pluss.rowpriv): closed-form histograms vs the brute
+single-iteration oracle, eligibility gates, and engine-level equality."""
+
+import numpy as np
+import pytest
+
+from pluss import engine, rowpriv
+from pluss.config import DEFAULT, SamplerConfig
+from pluss.models import syrk_triangular, trmm
+from pluss.sched import ChunkSchedule
+from pluss.spec import Loop, LoopNestSpec, Ref, flatten_nest
+
+
+def refs_of(spec, arr):
+    return [fr for fr in flatten_nest(spec.nests[0]) if fr.ref.array == arr]
+
+
+def sched_of(spec, cfg):
+    n = spec.nests[0]
+    return ChunkSchedule(cfg.chunk_size, n.trip, n.start, n.step,
+                         cfg.thread_num)
+
+
+@pytest.mark.parametrize("n,cls", [(16, 8), (16, 64), (24, 16), (13, 8)])
+def test_group_hist_matches_brute_every_g(n, cls):
+    spec = syrk_triangular(n)
+    cfg = SamplerConfig(cls=cls)
+    frs = refs_of(spec, "C")
+    assert rowpriv.eligible(spec, 0, frs) is None
+    sched = sched_of(spec, cfg)
+    hg = rowpriv.group_hist(frs, cfg, sched, n)
+    if (cls // cfg.ds) * cfg.ds != cls or (n * cfg.ds) % cls:
+        assert hg is None  # misaligned rows: must refuse, not approximate
+        return
+    assert hg is not None
+    for g in range(n):   # EVERY iteration, not just the plan-time samples
+        np.testing.assert_array_equal(
+            hg[g], rowpriv.brute_iteration_hist(frs, cfg, g), err_msg=str(g))
+
+
+def test_syrk_tri_c_qualifies_a_does_not():
+    spec = syrk_triangular(16)
+    assert rowpriv.eligible(spec, 0, refs_of(spec, "C")) is None
+    assert rowpriv.eligible(spec, 0, refs_of(spec, "A")) is not None
+
+
+def test_misaligned_rows_refused():
+    # n=13, cls=64: row stride 13*8=104 bytes is not line-aligned
+    spec = syrk_triangular(13)
+    cfg = SamplerConfig(cls=64)
+    frs = refs_of(spec, "C")
+    assert rowpriv.group_hist(frs, cfg, sched_of(spec, cfg), 13) is None
+
+
+def test_plan_excludes_rowpriv_refs():
+    pl = engine.plan(syrk_triangular(16), SamplerConfig(cls=8))
+    np_ = pl.nests[0]
+    assert np_.rpg_hist is not None
+    assert sorted(fr.ref.name for fr in np_.refs) == ["A0", "A1"]
+    assert np_.rpg_hist.shape[0] == DEFAULT.thread_num
+    # the excluded refs' events (reuses + colds) are all in the table:
+    # the grand total must equal C's stream size (every access is either a
+    # cold or a reuse — C lines are private, nothing resolves elsewhere)
+    n = 16
+    expect = sum((2 + 2 * n) * (g + 1) for g in range(n))
+    assert int(np_.rpg_hist.sum()) == expect
+
+
+@pytest.mark.parametrize("model,n,cls", [
+    ("syrk_tri", 16, 8), ("syrk_tri", 12, 64), ("trmm", 12, 8),
+    ("symm", 12, 8), ("covariance", 12, 8),
+])
+def test_run_equal_with_and_without_rowpriv(model, n, cls, monkeypatch):
+    from pluss.models import REGISTRY
+
+    spec = REGISTRY[model](n)
+    cfg = SamplerConfig(cls=cls)
+    a = engine.run(spec, cfg)
+    monkeypatch.setenv("PLUSS_NO_ROWPRIV", "1")
+    engine.compiled.cache_clear()
+    engine._plan_cached.cache_clear()
+    b = engine.run(spec, cfg)
+    monkeypatch.delenv("PLUSS_NO_ROWPRIV")
+    engine.compiled.cache_clear()
+    engine._plan_cached.cache_clear()
+    assert a.max_iteration_count == b.max_iteration_count
+    np.testing.assert_array_equal(a.noshare_dense, b.noshare_dense)
+    assert a.share_list() == b.share_list()
+
+
+def test_rowpriv_with_dynamic_assignment_and_resume():
+    # the [T, NW] table is built from the owned matrix, so permuted chunk
+    # maps and resume skips must be encoded exactly
+    spec = syrk_triangular(16)
+    cfg = SamplerConfig(cls=8)
+    from tests.oracle import OracleSampler
+
+    asg = tuple(np.random.default_rng(5).integers(0, 4, 4).tolist())
+    a = engine.run(spec, cfg, assignment=(asg,))
+    o = OracleSampler(spec, cfg).run(assignment=(asg,))
+    assert a.noshare_list() == o.noshare
+    b = engine.run(spec, cfg, start_point=8)
+    o2 = OracleSampler(spec, cfg).run(start_point=8)
+    assert b.noshare_list() == o2.noshare
+
+
+def test_sliced_runner_carries_rowpriv_tables():
+    spec = syrk_triangular(16)
+    cfg = SamplerConfig(cls=8)
+    a = engine.run(spec, cfg)
+    b = engine.run_sliced(spec, cfg, max_dispatch_entries=1)
+    np.testing.assert_array_equal(a.noshare_dense, b.noshare_dense)
+    assert a.share_list() == b.share_list()
+
+
+def test_all_rowpriv_nest_pure_table():
+    # a nest whose ONLY array is row-private: windows become pure table
+    # adds (the empty-sort-refs branch)
+    n = 16
+    spec = LoopNestSpec(
+        name="rowwalk",
+        arrays=(("X", n * n),),
+        nests=(Loop(trip=n, body=(
+            Loop(trip=n, bound_coef=(1, 1), body=(
+                Ref("X0", "X", addr_terms=((0, n), (1, 1))),
+                Ref("X1", "X", addr_terms=((0, n), (1, 1))),
+            )),
+        )),),
+    )
+    cfg = SamplerConfig(cls=8)
+    pl = engine.plan(spec, cfg)
+    assert pl.nests[0].rpg_hist is not None and not pl.nests[0].refs
+    from tests.oracle import OracleSampler
+
+    res = engine.run(spec, cfg)
+    o = OracleSampler(spec, cfg).run()
+    assert res.noshare_list() == o.noshare
+    assert res.max_iteration_count == o.max_iteration_count
