@@ -226,10 +226,14 @@ class NestPlan:
     #: (window index tuple, per-bucket FlatRefs); None for rectangular nests
     tri_buckets: tuple | None = None
     #: triangular nests only: [T, NW, NBINS] precomputed per-window event
-    #: histograms of the nest's row-private arrays (pluss.rowpriv) — their
-    #: refs are EXCLUDED from ``refs``/``tri_buckets`` and the device adds
-    #: one table row per window instead of sorting their stream
+    #: histograms of the nest's closed-form arrays (pluss.rowpriv row-
+    #: private groups + pluss.sweepgroup D/S pairs) — their refs are
+    #: EXCLUDED from ``refs``/``tri_buckets`` and the device adds one
+    #: table row per window instead of sorting their stream
     rpg_hist: np.ndarray | None = None
+    #: per-thread static share additions of the sweep groups: tuple of
+    #: {raw reuse value: count} dicts, applied by run()'s finalize
+    static_share: tuple | None = None
 
     def ultra_windows(self) -> np.ndarray:
         """[NW] bool: windows on the static-template path (clean for EVERY
@@ -746,20 +750,29 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             _plan_cache_put(cache_key, {"tpl": tpl, "overlays": None})
         refs_sort = refs
         rpg_hist = None
+        static_share = None
         if tri and build_rowpriv:
-            # row-private arrays: per-window histograms become a host
-            # table, their refs leave the device sort entirely
-            # (pluss.rowpriv; verified per group, falls back on mismatch)
-            from pluss import rowpriv
+            # closed-form groups: row-private arrays (pluss.rowpriv) and
+            # D+S sweep pairs (pluss.sweepgroup) become host histogram
+            # tables (+ static share lists); their refs leave the device
+            # sort entirely.  Both verify per group at plan time and fall
+            # back to the sort path on any mismatch.
+            from pluss import rowpriv, sweepgroup
 
             refs_sort, rpg_hist = rowpriv.build_rowpriv(
                 spec, ni, refs, cfg, sched, owned, W, NW)
+            refs_sort, swg_hist, static_share = sweepgroup.build_sweepgroup(
+                spec, ni, refs_sort, cfg, sched, owned, W, NW, clock)
+            if swg_hist is not None:
+                rpg_hist = swg_hist if rpg_hist is None \
+                    else rpg_hist + swg_hist
         tri_buckets = _tri_buckets(refs_sort, owned, sched, cfg, W, NW) \
             if tri else None
         nests.append(NestPlan(sched, refs_sort, body, owned, W, NW, tpl,
                               clean, var_refs, overlays=overlays,
                               var_refs_novl=var_novl, clock=clock,
-                              tri_buckets=tri_buckets, rpg_hist=rpg_hist))
+                              tri_buckets=tri_buckets, rpg_hist=rpg_hist,
+                              static_share=static_share))
         if not tri:  # triangular nests already counted via body_slot above
             for t in range(T):
                 for cid in owned[t]:
@@ -950,7 +963,18 @@ def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
         # at its first stream slot
         win_start = nb + clock_row[r0 * cfg.chunk_size].astype(pdt)
     ev = carried_events(key_s, pos_s, span_s, valid_s, win_start)
-    hist_delta = event_histogram(ev) if with_hist else None
+    if with_hist:
+        from pluss.ops import pallas_events
+
+        if pallas_events.enabled():
+            # Pallas spike (SURVEY §7 item 10), PLUSS_PALLAS_EVENTS=1:
+            # fused single-pass event histogram; XLA path is the default
+            hist_delta = pallas_events.event_histogram_fused(
+                key_s, pos_s, span_s, valid_s, win_start, pdt)
+        else:
+            hist_delta = event_histogram(ev)
+    else:
+        hist_delta = None
     tails = extract_tails(key_s, pos_s, valid_s, sum(c for _, c in ranges))
     off = 0
     for b, c in ranges:
@@ -1720,6 +1744,12 @@ def _finalize(pl: StreamPlan, hist: np.ndarray, share_ys,
     # identical values and counts for every clean window of every thread
     add_static_share(share_raw,
                      [(n, int(n.ultra_windows().sum())) for n in pl.nests])
+    # sweep groups' share events are whole-run host-side constants too
+    for n_ in pl.nests:
+        if n_.static_share is not None:
+            for t, d in enumerate(share_raw):
+                for v, cnt in n_.static_share[t].items():
+                    d[v] = d.get(v, 0) + cnt
     if any(n.overlays for n in pl.nests):
         overlay_static_share(share_raw, pl)
         for t, d in enumerate(share_raw):

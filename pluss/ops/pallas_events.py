@@ -1,0 +1,137 @@
+"""Pallas spike (SURVEY §7 build-order item 10): fused event extraction.
+
+One TPU kernel fuses the post-sort event phase of a window —
+:func:`pluss.ops.reuse.carried_events` + :func:`event_histogram` — into a
+single VMEM pass: boundary detection, carried/cold classification, reuse
+differences, share masking, log2 binning, and the [NBINS] histogram
+accumulation, instead of XLA's fused elementwise prologue + one-hot matmul
+epilogue.  The sort itself stays on XLA's native sort (a hand-written
+Pallas replacement was evaluated and rejected: a sequential scalar LAT
+walk costs ~30 cycles/element on the scalar unit — slower than the vector
+sort pipeline it would replace; see PARITY.md round-4 notes).
+
+Strictly flag-gated (``PLUSS_PALLAS_EVENTS=1``) with the XLA path as the
+default and fallback: round 3's packed-sort spike taught that novel
+kernels can fault this image's TPU worker, so the default path must never
+depend on one.  A/B numbers live in PARITY.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from pluss.config import NBINS
+
+#: stream elements per grid step; 64 rows x 128 lanes (the in-kernel
+#: [rows, 128, 128] histogram reduction must fit VMEM alongside operands)
+BLOCK = 8 * 1024
+
+
+def enabled() -> bool:
+    return bool(os.environ.get("PLUSS_PALLAS_EVENTS"))
+
+
+def _kernel(key_ref, prev_key_ref, pos_ref, prev_pos_ref, span_ref,
+            real_ref, hist_ref):
+    """One stream block -> accumulate its event histogram into hist_ref.
+
+    ``real`` arrives precomputed (valid AND pos >= win_start): folding the
+    window-start scalar outside avoids an SMEM operand, which does not
+    batch under the engine's thread vmap."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    key = key_ref[:]
+    pos = pos_ref[:]
+    prev_pos = prev_pos_ref[:]
+    real = real_ref[:] != 0
+    same = key == prev_key_ref[:]
+    is_evt = real & same & (prev_pos >= 0)
+    cold = real & same & (prev_pos < 0)
+    reuse = jnp.where(is_evt, pos - prev_pos, 1)
+    span = span_ref[:]
+    share = is_evt & (span > 0) & (reuse > span // 2)
+    evt = is_evt & ~share
+    bits = jnp.iinfo(reuse.dtype).bits
+    bins = jnp.where(evt, (bits - jax.lax.clz(jnp.maximum(reuse, 1))),
+                     0).astype(jnp.int32)
+    wgt = (evt | cold).astype(jnp.float32)
+    # histogram over the [ROWS, 128] block without reshape: compare the
+    # block against each lane-aligned bin id and reduce — 128 padded bins
+    # (the host slices [:NBINS]); one [ROWS, 128, 128] masked reduction
+    ids = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 128), 2)
+    oh = (bins[:, :, None] == ids).astype(jnp.float32)
+    # per-block counts are exact in f32 (<= BLOCK < 2^24); the CROSS-block
+    # accumulator is int32 so totals stay exact past 2^24 (the XLA path's
+    # bin_histogram falls back to segment_sum there — match its contract)
+    local = jnp.sum(oh * wgt[:, :, None],
+                    axis=(0, 1))[None, :].astype(jnp.int32)
+
+    # first grid step owns the init; later steps accumulate (the output
+    # block is revisited every step — sequential on TPU)
+    @pl.when(i == 0)
+    def _():
+        hist_ref[:] = local
+
+    @pl.when(i > 0)
+    def _():
+        hist_ref[:] = hist_ref[:] + local
+
+
+@functools.lru_cache(maxsize=8)
+def _event_hist_fn(n: int, pos_dtype_name: str, backend: str):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if n % BLOCK:
+        raise ValueError(f"stream length {n} not a multiple of {BLOCK}")
+    rows = BLOCK // 128
+    grid = (n // BLOCK,)
+    # inputs arrive reshaped [n//128, 128] (TPU blocks need 2-D tiles with
+    # lane dim 128); index_map returns BLOCK indices (block units)
+    blk = lambda i: (i, 0)
+    specs = [pl.BlockSpec((rows, 128), blk, memory_space=pltpu.VMEM)
+             for _ in range(6)]
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=specs,
+        out_specs=pl.BlockSpec((1, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32),
+        # the CPU backend runs the kernel in the interpreter — correctness
+        # tests exercise the same code path the TPU compiles.  ``backend``
+        # is part of the memo key, so a backend switch rebuilds.
+        interpret=backend == "cpu",
+    )
+
+
+def event_histogram_fused(key_s, pos_s, span_s, valid_i, win_start, pdt):
+    """[NBINS] histogram of one ghost-merged sorted window, one fused pass.
+
+    Drop-in for ``event_histogram(carried_events(...))``; the caller pads
+    the window to a BLOCK multiple (invalid tail sorts last, so padding
+    with sentinel-invalid entries is safe).
+    """
+    n = key_s.shape[0]
+    pad = (-n) % BLOCK
+    if pad:
+        key_s = jnp.concatenate([key_s, jnp.full((pad,), -1, key_s.dtype)])
+        pos_s = jnp.concatenate([pos_s, jnp.zeros((pad,), pos_s.dtype)])
+        span_s = jnp.concatenate([span_s, jnp.zeros((pad,), span_s.dtype)])
+        valid_i = jnp.concatenate(
+            [valid_i, jnp.zeros((pad,), valid_i.dtype)])
+    prev_key = jnp.concatenate([jnp.full((1,), -2, key_s.dtype),
+                                key_s[:-1]])
+    prev_pos = jnp.concatenate([pos_s[:1], pos_s[:-1]])
+    real = ((valid_i != 0) & (pos_s >= win_start)).astype(jnp.int32)
+    fn = _event_hist_fn(int(key_s.shape[0]), jnp.dtype(pdt).name,
+                        jax.default_backend())
+    r2 = lambda a: a.reshape(-1, 128)
+    hist = fn(r2(key_s), r2(prev_key), r2(pos_s), r2(prev_pos),
+              r2(span_s), r2(real))
+    return hist[0, :NBINS].astype(pdt)

@@ -14,10 +14,16 @@ CLI_FLAGS=${PLUSS_CLI_FLAGS---cpu}
 # always try make (incremental, no-op when fresh): a stale prebuilt binary
 # would mis-parse the --spec flag used for non-gemm models.  A failed build
 # only warns — the Python CLI block below must still run and diagnose.
+NATIVE_OK=0
 if [ -d pluss/cpp ]; then
-  (cd pluss/cpp && make -s) || echo "run.sh: native build failed; skipping native block" >&2
+  if (cd pluss/cpp && make -s); then
+    NATIVE_OK=1
+  else
+    # a stale prebuilt binary would mis-parse --spec: skip entirely
+    echo "run.sh: native build failed; skipping native block" >&2
+  fi
 fi
-if [ -f pluss/cpp/build/pluss_cpp ]; then
+if [ "$NATIVE_OK" = 1 ] && [ -f pluss/cpp/build/pluss_cpp ]; then
   if [ "$MODEL" = gemm ]; then
     ./pluss/cpp/build/pluss_cpp "$METHOD" "$N" >> output.txt
   else

@@ -52,11 +52,17 @@ def test_misaligned_rows_refused():
     assert rowpriv.group_hist(frs, cfg, sched_of(spec, cfg), 13) is None
 
 
-def test_plan_excludes_rowpriv_refs():
+def test_plan_excludes_rowpriv_refs(monkeypatch, request):
+    # sweepgroup disabled: isolate rowpriv's exclusions (C refs only)
+    monkeypatch.setenv("PLUSS_NO_SWEEPGROUP", "1")
+    engine.compiled.cache_clear()
+    request.addfinalizer(engine.compiled.cache_clear)
     pl = engine.plan(syrk_triangular(16), SamplerConfig(cls=8))
     np_ = pl.nests[0]
     assert np_.rpg_hist is not None
     assert sorted(fr.ref.name for fr in np_.refs) == ["A0", "A1"]
+    monkeypatch.delenv("PLUSS_NO_SWEEPGROUP")
+    engine.compiled.cache_clear()
     assert np_.rpg_hist.shape[0] == DEFAULT.thread_num
     # the excluded refs' events (reuses + colds) are all in the table:
     # the grand total must equal C's stream size (every access is either a

@@ -84,9 +84,14 @@ def test_auto_dispatch_decision_over_budget(monkeypatch):
     assert "dispatch ceiling" in reason
 
 
-def test_auto_dispatch_memory_ladder(monkeypatch):
+def test_auto_dispatch_memory_ladder(monkeypatch, request):
     # memory ceiling one window under the 4-thread requirement: the ladder
-    # must halve concurrency until it fits, never raise
+    # must halve concurrency until it fits, never raise.  Closed-form
+    # groups off: the plan must actually HAVE sort windows to budget.
+    monkeypatch.setenv("PLUSS_NO_ROWPRIV", "1")
+    monkeypatch.setenv("PLUSS_NO_SWEEPGROUP", "1")
+    engine.compiled.cache_clear()
+    request.addfinalizer(engine.compiled.cache_clear)
     pl = engine._plan_cached(syrk_triangular(16), DEFAULT, None, None,
                              None, 1)
     need = max(engine.sort_window_bytes(

@@ -1,0 +1,110 @@
+"""Sweep groups (pluss.sweepgroup): closed-form D+S histograms vs the
+brute two-iteration oracle, eligibility gates, and engine equality."""
+
+import numpy as np
+import pytest
+
+from pluss import engine, sweepgroup
+from pluss.config import SamplerConfig
+from pluss.models import syrk_triangular
+from pluss.sched import ChunkSchedule
+from pluss.spec import flatten_nest, nest_iteration_size_affine
+
+
+def setup_tables(spec, cfg):
+    nest = spec.nests[0]
+    frs = [fr for fr in flatten_nest(nest) if fr.ref.array == "A"]
+    sched = ChunkSchedule(cfg.chunk_size, nest.trip, nest.start, nest.step,
+                          cfg.thread_num)
+    owned = engine._owned_matrix(sched, cfg.thread_num, None, None)
+    n0, n1 = nest_iteration_size_affine(nest)
+    CS = cfg.chunk_size
+    g = owned[:, :, None].astype(np.int64) * CS + np.arange(CS)
+    valid = (owned[:, :, None] >= 0) & (g < sched.trip)
+    body = np.where(valid, n0 + n1 * g, 0).reshape(cfg.thread_num, -1)
+    clock = np.concatenate(
+        [np.zeros((cfg.thread_num, 1), np.int64),
+         np.cumsum(body, axis=1)], axis=1)[:, :-1]
+    return frs, sched, owned, clock
+
+
+@pytest.mark.parametrize("n,cls", [(16, 8), (16, 64), (24, 16), (13, 8)])
+def test_every_slot_matches_brute_pair(n, cls):
+    """EVERY owned slot of every thread vs the two-iteration oracle (the
+    plan-time _verify only samples; this is the exhaustive version)."""
+    spec = syrk_triangular(n)
+    cfg = SamplerConfig(cls=cls)
+    frs, sched, owned, clock = setup_tables(spec, cfg)
+    assert sweepgroup.eligible(spec, 0, frs, cfg, sched) is None
+    d = next(fr for fr in frs if fr.addr_coefs[0])
+    s = next(fr for fr in frs if not fr.addr_coefs[0])
+    for t in range(cfg.thread_num):
+        out = sweepgroup._derive_thread(d, s, cfg, sched, owned[t], 1,
+                                        owned.shape[1], clock[t])
+        assert out is not None
+        _, _, slots = out
+        for pi in range(len(slots)):
+            idx, g, clk = slots[pi]
+            gp, clkp = (None, 0) if pi == 0 else slots[pi - 1][1:]
+            want = sweepgroup.brute_pair_hist(d, s, cfg, gp, g, clkp, clk)
+            got = sweepgroup._slot_contribution(d, s, cfg, gp, g, clkp,
+                                                clk)
+            assert got is not None, (t, pi)
+            np.testing.assert_array_equal(got[0], want[0],
+                                          err_msg=f"t={t} slot={pi}")
+            assert got[1] == want[1], (t, pi)
+
+
+def test_engine_equality_with_and_without(monkeypatch):
+    for n, cls in [(16, 8), (24, 16), (13, 8)]:
+        spec = syrk_triangular(n)
+        cfg = SamplerConfig(cls=cls)
+        a = engine.run(spec, cfg)
+        monkeypatch.setenv("PLUSS_NO_SWEEPGROUP", "1")
+        engine.compiled.cache_clear()
+        b = engine.run(spec, cfg)
+        monkeypatch.delenv("PLUSS_NO_SWEEPGROUP")
+        engine.compiled.cache_clear()
+        assert a.max_iteration_count == b.max_iteration_count
+        np.testing.assert_array_equal(a.noshare_dense, b.noshare_dense)
+        assert a.share_list() == b.share_list()
+
+
+def test_plan_empties_syrk_tri_sort_refs():
+    # rowpriv (C) + sweepgroup (A): no device sort left at all
+    pl = engine.plan(syrk_triangular(16), SamplerConfig(cls=8))
+    assert not pl.nests[0].refs
+    assert pl.nests[0].rpg_hist is not None
+    assert pl.nests[0].static_share is not None
+
+
+def test_dynamic_assignment_and_resume_vs_oracle():
+    from tests.oracle import OracleSampler
+
+    spec = syrk_triangular(16)
+    cfg = SamplerConfig(cls=8)
+    asg = (1, 3, 0, 2)
+    a = engine.run(spec, cfg, assignment=(asg,))
+    o = OracleSampler(spec, cfg).run(assignment=(asg,))
+    assert a.noshare_list() == o.noshare
+    assert a.share_list() == [
+        {k: dict(v) for k, v in h.items()} for h in o.share]
+    b = engine.run(spec, cfg, start_point=8)
+    o2 = OracleSampler(spec, cfg).run(start_point=8)
+    assert b.noshare_list() == o2.noshare
+
+
+def test_sliced_runner_with_sweepgroup():
+    spec = syrk_triangular(16)
+    cfg = SamplerConfig(cls=8)
+    a = engine.run(spec, cfg)
+    b = engine.run_sliced(spec, cfg, max_dispatch_entries=1)
+    np.testing.assert_array_equal(a.noshare_dense, b.noshare_dense)
+    assert a.share_list() == b.share_list()
+
+
+def test_misaligned_refused():
+    spec = syrk_triangular(13)   # 13*8 % 64 != 0
+    cfg = SamplerConfig(cls=64)
+    frs, sched, _, _ = setup_tables(spec, cfg)
+    assert sweepgroup.eligible(spec, 0, frs, cfg, sched) is not None
