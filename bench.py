@@ -405,9 +405,15 @@ def bench_trace(n_refs: int) -> None:
                 f"at the current feed rate; shrinking to {n_run} refs "
                 f"(~{budget_s:.0f}s budget)")
     t0 = time.perf_counter()
-    rep = trace.replay_file(path, limit_refs=n_run)
+    # the deadline (1.3x the projected budget) is the backstop for the
+    # feed SLOWING mid-run — a pre-run projection cannot see that
+    # (observed: projected at ~23 MB/s, finished at ~5 MB/s, 3x over)
+    rep = trace.replay_file(
+        path, limit_refs=n_run,
+        deadline_s=min(budget_s * 1.3, max(remaining_s() - 30, 1)))
     best_s = time.perf_counter() - t0
-    log(f"bench: {rep.total_count} refs over {rep.n_lines} line slots")
+    n_run = rep.total_count
+    log(f"bench: {n_run} refs over {rep.n_lines} line slots")
     # native replay is linear in refs, so one measured (refs, seconds) pair
     # scales to whatever prefix the feed budget allowed this round
     rate = native_trace_rate(path)

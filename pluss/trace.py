@@ -403,7 +403,8 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                 window: int = TRACE_WINDOW, precompacted: bool = False,
                 initial_capacity: int = 1 << 20,
                 limit_refs: int | None = None,
-                pipeline: bool = True) -> ReplayResult:
+                pipeline: bool = True,
+                deadline_s: float | None = None) -> ReplayResult:
     """Replay a trace FILE in bounded host memory (BASELINE config 5 scale).
 
     Unlike ``replay(load_trace(path))``, which slurps the whole file, this
@@ -413,6 +414,12 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     more than one batch on the host.  The device line table starts at
     ``initial_capacity`` ids and doubles as the compactor discovers the
     working set (each growth retraces the jitted step — O(log) growths).
+
+    ``deadline_s``: optional wall clock cap — the batch loop stops cleanly
+    after the batch in flight when exceeded, returning the refs actually
+    replayed (``total_count`` reflects the truncation).  A pre-run
+    projection cannot defend against the tunneled feed SLOWING mid-run
+    (observed: a run projected fine at ~23 MB/s finished at ~5 MB/s).
     """
     if fmt == "text":  # line-oriented; no random access worth streaming
         return replay(load_trace(path, fmt), cls, window,
@@ -474,10 +481,14 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
 
     src = _threaded(batches) if pipeline else \
         contextlib.nullcontext(batches())
+    import time as _time
+
+    t0 = _time.perf_counter()
     capacity = initial_capacity
     last_pos = jnp.full((capacity,), -1, pdt)
     hist = jnp.zeros((NBINS,), pdt)
     n_lines = 0
+    done = 0
     with src as it:
         for b, (ids, n_lines) in enumerate(it):
             if n_lines > capacity:
@@ -493,7 +504,20 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                 last_pos, hist, pdt.type(b * batch), jnp.asarray(shaped),
                 pdt.type(n),
             )
-    return ReplayResult(np.asarray(hist, np.int64), n, n_lines)
+            done = min(n, (b + 1) * batch)
+            # the cheap unsynced clock runs every batch; the device sync
+            # (which is what makes the elapsed time REAL under async
+            # dispatch) is only paid once the unsynced time is already
+            # over — so a fast run never syncs, and a slow feed cannot
+            # overshoot by more than one batch
+            if deadline_s is not None and done < n \
+                    and _time.perf_counter() - t0 > deadline_s:
+                np.asarray(hist[:1])
+                if _time.perf_counter() - t0 > deadline_s:
+                    # truncation is clean at a batch boundary: every
+                    # processed position is < done, none beyond dispatched
+                    break
+    return ReplayResult(np.asarray(hist, np.int64), done, n_lines)
 
 
 def pack_file(path: str, out_path: str, cls: int = 64,

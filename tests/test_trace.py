@@ -296,3 +296,22 @@ def test_shard_replay_file_ragged_slice_boundary(tmp_path):
     b = trace.shard_replay_file(str(p), window=window, batch_windows=2)
     assert int(a.hist.sum()) == n
     np.testing.assert_array_equal(a.hist, b.hist)
+
+
+def test_replay_file_deadline_truncates_cleanly(tmp_path):
+    # a zero deadline stops after the first sync point; the result must be
+    # an EXACT prefix replay with an honest total_count
+    rng = np.random.default_rng(37)
+    window = 1 << 8
+    n = 8 * window * 12
+    addrs = rng.integers(0, 1 << 11, n, dtype=np.int64) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    res = trace.replay_file(str(p), window=window, deadline_s=0.0)
+    assert 0 < res.total_count < n
+    assert res.total_count % (8 * window) == 0   # batch-boundary cut
+    ref = trace.replay(addrs[:res.total_count], window=window)
+    np.testing.assert_array_equal(res.hist, ref.hist)
+    # no deadline: unchanged behavior
+    full = trace.replay_file(str(p), window=window)
+    assert full.total_count == n
