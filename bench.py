@@ -11,15 +11,19 @@ the SAME workload on this host — the stand-in for the reference's serialized
 Rust/C++ backends (its Rayon/spawn backends hold whole-lifetime locks and run
 sequentially, SURVEY.md Q2, so the native walk is a faithful proxy).
 
-Prints one JSON line PER METRIC on stdout — the flagship GEMM line FIRST so
-the round record always holds the headline number, then the aux metrics,
-each gated on a GLOBAL wall budget (PLUSS_BENCH_BUDGET_S, default 1200 s):
-an aux metric whose estimated cost exceeds the remaining budget is skipped
-with a logged reason instead of timing the whole bench out (round 3's record
-died at rc=124 with the flagship still queued — never again).  Native C++
-baselines are measured once and cached on disk keyed by a hash of the native
-sources, so repeat runs spend the budget on TPU metrics, not on re-timing
-an unchanged host binary.
+Prints one JSON line per metric on stdout.  The flagship GEMM line is
+emitted FIRST (so a timeout can never lose the headline — round 3's record
+died at rc=124 with the flagship still queued) and then RE-emitted as the
+final line (the driver's parsed headline is the last JSON line of the run,
+see BENCH_r02/r03 "parsed" — consumers must dedup by metric name).  Aux
+metrics in between are each gated on a GLOBAL wall budget
+(PLUSS_BENCH_BUDGET_S, default 1140 s — just under a presumed ~1200 s
+driver timeout so the graceful SKIP path wins the race against a hard
+kill): an aux metric whose estimated cost exceeds the remaining budget is
+skipped with a logged reason instead of timing the whole bench out.
+Native C++ baselines are measured once and cached on disk keyed by a hash
+of the native sources, so repeat runs spend the budget on TPU metrics, not
+on re-timing an unchanged host binary.
 
 Robustness: this image's sitecustomize registers a tunneled-TPU backend that
 can hang indefinitely if the tunnel is wedged, so the accelerator is probed in
@@ -39,7 +43,10 @@ import time
 PROBE_TIMEOUT_S = 120
 REPS = 3
 _T_START = time.monotonic()
-BUDGET_S = float(os.environ.get("PLUSS_BENCH_BUDGET_S", 1200))
+# default wall budget: slightly under the 20-minute mark so that if the
+# driver wraps the bench in its own ~1200 s timeout, the graceful SKIP
+# path always wins the race against a hard rc=124 kill
+BUDGET_S = float(os.environ.get("PLUSS_BENCH_BUDGET_S", 1140))
 NATIVE_CACHE = ".bench/native_cache.json"
 
 
@@ -474,11 +481,14 @@ def main() -> int:
     # cache can degrade vs_baseline to null but can never block the line.
     # try/except so a mid-rep worker death still lets the aux metrics run
     # (a partial record beats an empty one).
+    flagship = None
     try:
         best_s, res = timed_reps(step_of(gemm(1024)), REPS, "gemm1024")
-        emit("gemm1024_sampler_refs_per_sec", res.max_iteration_count,
-             best_s, cached_native_s("gemm1024",
-                                     lambda: native_baseline_s(1024)))
+        flagship = ("gemm1024_sampler_refs_per_sec",
+                    res.max_iteration_count, best_s,
+                    cached_native_s("gemm1024",
+                                    lambda: native_baseline_s(1024)))
+        emit(*flagship)
     except Exception as e:
         log(f"bench: FLAGSHIP gemm1024 metric failed: {e}")
 
@@ -536,6 +546,42 @@ def main() -> int:
             bench_trace(trace_refs)
         except Exception as e:
             log(f"bench: trace metric failed: {e}")
+
+    # accuracy half of the north star (BASELINE.json: "miss-ratio-curve L2
+    # error vs C++ baseline" within 1%): MRC of the TPU pipeline vs the
+    # native C++ runtime on the reference workload.  The acc-mode byte-diff
+    # tests prove histogram identity; this line puts the number in the
+    # round record next to the speed half.  Deliberately LAST among the aux
+    # metrics: on a tight budget the round-over-round comparable metrics
+    # above must win the remaining budget over this (new in r4) line.
+    if budget_ok("gemm_mrc_l2", 60):
+        try:
+            from pluss import mrc as mrc_mod
+            from pluss import native
+
+            res = engine.run(gemm(128))
+            ri = cri.distribute(res.noshare_list(), res.share_list(),
+                                DEFAULT.thread_num)
+            ours = mrc_mod.aet_mrc(ri)
+            if native.available(autobuild=True):
+                theirs = native.run(gemm(128)).mrc()
+                err = mrc_mod.l2_error(ours, theirs)
+                log(f"bench: gemm128 MRC L2 error vs native C++: {err:.2e}")
+                print(json.dumps({
+                    "metric": "gemm128_mrc_l2_error_vs_native",
+                    "value": round(err, 9), "unit": "relative_l2",
+                    "vs_baseline": None,
+                }), flush=True)
+        except Exception as e:
+            log(f"bench: mrc l2 metric failed: {e}")
+
+    # re-emit the flagship LAST: the round record's parsed headline is the
+    # final JSON line of the run (see BENCH_r02/r03 "parsed"), and an aux
+    # metric must not displace the north-star number from it.  Identical
+    # payload to the first emission — purely a record-ordering concern.
+    if flagship is not None:
+        log("bench: re-emitting flagship line as the record headline")
+        emit(*flagship)
     return 0
 
 
