@@ -10,6 +10,8 @@ from pluss.models.linalg import (atax, bicg, doitgen, gemver, gesummv,
                                  jacobi2d, mvt)
 from pluss.models.polybench import (correlation, covariance, mm2, mm3,
                                     symm, syr2k, syrk, syrk_triangular, trmm)
+from pluss.models.solvers import (durbin, floyd_warshall, gramschmidt,
+                                  trisolv)
 from pluss.models.stencils import conv2d, fdtd2d, heat3d, stencil3d
 
 REGISTRY = {
@@ -34,11 +36,17 @@ REGISTRY = {
     "gemver": gemver,
     "fdtd2d": fdtd2d,
     "heat3d": heat3d,
+    "trisolv": trisolv,
+    "durbin": durbin,
+    "gramschmidt": gramschmidt,
+    "floyd_warshall": floyd_warshall,
 }
 
 __all__ = [
     "gemm", "mm2", "mm3", "syrk", "syr2k", "conv2d", "stencil3d",
     "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d",
-    "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm", "covariance", "correlation",
+    "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm",
+    "covariance", "correlation", "trisolv", "durbin", "gramschmidt",
+    "floyd_warshall",
     "REGISTRY",
 ]

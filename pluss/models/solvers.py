@@ -1,0 +1,188 @@
+"""PolyBench 4.2 solver/medley specs: trisolv, durbin, gramschmidt,
+floyd_warshall.
+
+Authored in the same ppcg/pluss generated-sampler style as
+``/root/reference/c_lib/test/gemm.ppcg_omp.c:72-98`` (outermost loop =
+the parallel dim, loads precede the store of the same statement, an
+accumulation statement re-loads and re-stores its output element every
+step, scalars live in registers and are not walked — the convention the
+generated GEMM sampler encodes at ``…omp.cpp:214-300``).
+
+These four cover the remaining PolyBench kernels expressible under the
+spec language's affine contract (``pluss.spec.Loop``: inner bounds and
+starts affine in the parallel index, bounded loops not nested inside
+each other).  Each stresses a distinct corner of the engine:
+
+- ``trisolv``: the canonical triangular solve — one bounded inner loop
+  plus rectangular tail refs after it (nonzero ``offset_k`` on the tail).
+- ``durbin``: NEGATIVE address coefficients (``r[k-i-1]``/``y[k-i-1]``
+  walk arrays backwards; ``addr_base=-1``) and three sibling bounded
+  loops with refs between them.
+- ``gramschmidt``: rectangular i-loops nested inside the bounded
+  ``j in [k+1, n)`` loop (``start_coef=1`` with ``bound_coef=(n-1,-1)``),
+  plus diagonal refs ``R[k][k]``.
+- ``floyd_warshall``: ONE array under three access patterns, one of them
+  parallel-invariant (``path[i][j]`` has no ``k`` term — every simulated
+  thread re-touches the same address set each iteration).
+
+Doubly-triangular kernels (cholesky, lu, ludcmp, nussinov) have inner
+trip counts quadratic in the parallel index — outside the affine
+contract by design (``pluss.spec.loop_size_affine`` rejects them); they
+would need the general sort path with value-dependent masks per level.
+"""
+
+from __future__ import annotations
+
+from pluss.spec import Loop, LoopNestSpec, Ref, share_span_formula
+
+
+def trisolv(n: int = 128) -> LoopNestSpec:
+    """trisolv: ``x = L^-1 b`` by forward substitution.
+
+    Per parallel iteration ``i``: ``x[i] = b[i]`` (load b, store x); the
+    bounded ``j < i`` loop does ``x[i] -= L[i][j]*x[j]`` (loads L, x[j],
+    x[i]; store x[i]); then ``x[i] /= L[i][i]`` (loads x[i], L[i][i];
+    store x[i]).  ``x[j]`` is the cross-thread reference: every later
+    parallel iteration re-reads the prefix ``x[0..i)``.
+    """
+    span = share_span_formula(n)
+    x_i = lambda nm: Ref(nm, "x", addr_terms=((0, 1),))
+    jloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
+        Ref("L0", "L", addr_terms=((0, n), (1, 1))),
+        Ref("X1", "x", addr_terms=((1, 1),), share_span=span),
+        x_i("X2"),
+        x_i("X3"),
+    ))
+    nest = Loop(trip=n, body=(
+        Ref("B0", "b", addr_terms=((0, 1),)),
+        x_i("X0"),
+        jloop,
+        x_i("X4"),
+        Ref("L1", "L", addr_terms=((0, n + 1),)),      # diagonal L[i][i]
+        x_i("X5"),
+    ))
+    return LoopNestSpec(
+        name=f"trisolv{n}",
+        arrays=(("x", n), ("L", n * n), ("b", n)),
+        nests=(nest,),
+    )
+
+
+def durbin(n: int = 128) -> LoopNestSpec:
+    """durbin: Levinson-Durbin recursion on a Toeplitz system.
+
+    Parallel loop ``k in [1, n)`` (start=1, trip n-1); all three inner
+    loops run ``i < k`` (``bound_coef=(1, 1)``).  Per k: the sum loop
+    loads ``r[k-i-1]`` (addr ``k - i - 1``: terms ``((0,1),(1,-1))``,
+    base −1 — a backwards walk) and ``y[i]``; then ``r[k]`` (the alpha
+    statement); the z-loop loads ``y[i]``, ``y[k-i-1]`` and stores
+    ``z[i]``; the copy loop loads ``z[i]`` and stores ``y[i]``; finally
+    ``y[k]`` is stored.  Every prefix-indexed ref (y, z, and the
+    backwards r walk) recurs across parallel iterations — all carry the
+    share span; ``r[k]``/``y[k]`` ride the parallel iterator and stay
+    thread-private.  Scalars (alpha, beta, sum) are registers.
+    """
+    span = share_span_formula(n)
+    back = lambda nm, arr: Ref(nm, arr, addr_terms=((0, 1), (1, -1)),
+                               addr_base=-1, share_span=span)
+    sum_loop = Loop(trip=max(n - 1, 1), bound_coef=(1, 1), body=(
+        back("R0", "r"),
+        Ref("Y0", "y", addr_terms=((1, 1),), share_span=span),
+    ))
+    z_loop = Loop(trip=max(n - 1, 1), bound_coef=(1, 1), body=(
+        Ref("Y1", "y", addr_terms=((1, 1),), share_span=span),
+        back("Y2", "y"),
+        Ref("Z0", "z", addr_terms=((1, 1),), share_span=span),
+    ))
+    copy_loop = Loop(trip=max(n - 1, 1), bound_coef=(1, 1), body=(
+        Ref("Z1", "z", addr_terms=((1, 1),), share_span=span),
+        Ref("Y3", "y", addr_terms=((1, 1),), share_span=span),
+    ))
+    nest = Loop(trip=n - 1, start=1, body=(
+        sum_loop,
+        Ref("R1", "r", addr_terms=((0, 1),)),
+        z_loop,
+        copy_loop,
+        Ref("Y4", "y", addr_terms=((0, 1),)),
+    ))
+    return LoopNestSpec(
+        name=f"durbin{n}",
+        arrays=(("y", n), ("z", n), ("r", n)),
+        nests=(nest,),
+    )
+
+
+def gramschmidt(n: int = 128) -> LoopNestSpec:
+    """gramschmidt: QR by modified Gram-Schmidt (square m = n).
+
+    Per parallel iteration ``k``: the norm loop loads ``A[i][k]`` twice
+    (the two operand occurrences of ``A[i][k]*A[i][k]``); ``R[k][k]`` is
+    stored; the Q loop loads ``A[i][k]``, ``R[k][k]`` and stores
+    ``Q[i][k]``; then ``j in [k+1, n)`` (``start_coef=1``,
+    ``bound_coef=(n-1,-1)``) runs two rectangular i-loops: the projection
+    (``R[k][j] += Q[i][k]*A[i][j]`` — zero-store, then load Q, load A,
+    load+store R) and the update (``A[i][j] -= Q[i][k]*R[k][j]`` — load
+    A, load Q, load R, store A).  Column ``j > k`` of A is re-read AND
+    re-written by every earlier parallel iteration, and column ``k`` was
+    written as some earlier iteration's ``j`` — so all A refs carry the
+    share span; Q and R columns/rows ride the parallel iterator.
+    """
+    span = share_span_formula(n)
+    a_ik = lambda nm: Ref(nm, "A", addr_terms=((1, n), (0, 1)),
+                          share_span=span)
+    r_kk = lambda nm: Ref(nm, "R", addr_terms=((0, n + 1),))
+    norm_loop = Loop(trip=n, body=(a_ik("A0"), a_ik("A1")))
+    q_loop = Loop(trip=n, body=(
+        a_ik("A2"),
+        r_kk("R1"),
+        Ref("Q0", "Q", addr_terms=((1, n), (0, 1))),
+    ))
+    q_ik = lambda nm: Ref(nm, "Q", addr_terms=((2, n), (0, 1)))
+    r_kj = lambda nm: Ref(nm, "R", addr_terms=((0, n), (1, 1)))
+    a_ij = lambda nm: Ref(nm, "A", addr_terms=((2, n), (1, 1)),
+                          share_span=span)
+    proj_loop = Loop(trip=n, body=(
+        q_ik("Q1"), a_ij("A3"), r_kj("R3"), r_kj("R4"),
+    ))
+    update_loop = Loop(trip=n, body=(
+        a_ij("A4"), q_ik("Q2"), r_kj("R5"), a_ij("A5"),
+    ))
+    jloop = Loop(
+        trip=max(n - 1, 1), start=1, start_coef=1, bound_coef=(n - 1, -1),
+        body=(r_kj("R2"), proj_loop, update_loop),
+    )
+    nest = Loop(trip=n, body=(norm_loop, r_kk("R0"), q_loop, jloop))
+    return LoopNestSpec(
+        name=f"gramschmidt{n}",
+        arrays=(("A", n * n), ("R", n * n), ("Q", n * n)),
+        nests=(nest,),
+    )
+
+
+def floyd_warshall(n: int = 128) -> LoopNestSpec:
+    """floyd_warshall: all-pairs shortest paths; parallel over ``k``.
+
+    Per (k, i, j): ``path[i][j] = min(path[i][j], path[i][k]+path[k][j])``
+    — loads path[i][j], path[i][k], path[k][j], stores path[i][j].  One
+    array, three patterns: ``path[i][j]`` is PARALLEL-INVARIANT (no k
+    term — every simulated thread revisits the identical address set),
+    ``path[k][j]`` rides row k, and ``path[i][k]`` walks column k (which
+    earlier iterations wrote as their ``j = k``).  Every ref's reuses can
+    cross threads, so all four carry the share span and the per-reuse
+    distance test classifies them individually.
+    """
+    span = share_span_formula(n)
+    p_ij = lambda nm: Ref(nm, "path", addr_terms=((1, n), (2, 1)),
+                          share_span=span)
+    inner = Loop(trip=n, body=(
+        p_ij("P0"),
+        Ref("P1", "path", addr_terms=((1, n), (0, 1)), share_span=span),
+        Ref("P2", "path", addr_terms=((0, n), (2, 1)), share_span=span),
+        p_ij("P3"),
+    ))
+    nest = Loop(trip=n, body=(Loop(trip=n, body=(inner,)),))
+    return LoopNestSpec(
+        name=f"floyd_warshall{n}",
+        arrays=(("path", n * n),),
+        nests=(nest,),
+    )

@@ -313,3 +313,14 @@ def mrc_dedup_lines(mrc: dict) -> list[tuple[int, float]]:
             lines.append((keys[i2], mrc[keys[i2]]))
         i1 = i2 + 1
     return lines
+
+
+def assert_result_matches_oracle(spec, cfg, res, **kw):
+    """Shared engine-result ≡ oracle comparison (one home — test_engine,
+    test_triangular and test_solvers all compare the same three facts)."""
+    o = OracleSampler(spec, cfg).run(**kw)
+    assert res.max_iteration_count == o.max_iteration_count
+    assert res.noshare_list() == o.noshare
+    assert res.share_list() == [
+        {k: dict(v) for k, v in h.items()} for h in o.share
+    ]

@@ -6,20 +6,14 @@ import pytest
 from pluss.config import SamplerConfig
 from pluss.engine import run
 from pluss.models import REGISTRY, gemm
-from tests.oracle import OracleSampler, merge_noshare, merge_share
+from tests.oracle import (OracleSampler, assert_result_matches_oracle,
+                          merge_noshare, merge_share)
 
 
 def assert_matches_oracle(spec, cfg, **kw):
-    o = OracleSampler(spec, cfg).run(
-        assignment=kw.get("assignment"), start_point=kw.get("start_point")
-    )
-    r = run(spec, cfg, **kw)
-    assert r.max_iteration_count == o.max_iteration_count
-    for t in range(cfg.thread_num):
-        assert r.noshare_dict(t) == o.noshare[t], f"tid {t} noshare"
-        got_share = r.share_dict(t)
-        want_share = {k: dict(v) for k, v in o.share[t].items() if v}
-        assert got_share == want_share, f"tid {t} share"
+    assert_result_matches_oracle(
+        spec, cfg, run(spec, cfg, **kw),
+        assignment=kw.get("assignment"), start_point=kw.get("start_point"))
 
 
 SMALL_CFGS = [

@@ -1,0 +1,100 @@
+"""PolyBench solver/medley families (pluss.models.solvers) vs the oracle.
+
+Each family pins a distinct engine corner (see the module docstring of
+:mod:`pluss.models.solvers`): trisolv (bounded loop + rectangular tail),
+durbin (negative address coefficients, sibling bounded loops), gramschmidt
+(rectangular loops inside a bounded varying-start loop), floyd_warshall
+(parallel-invariant access pattern on a single array).  The reference has
+no such samplers (its one workload is rectangular GEMM,
+``/root/reference/c_lib/test/gemm.ppcg_omp.c:90-96``) — this is capability
+surface, tested the way SURVEY.md §4 prescribes: parallel semantics must
+equal sequential enumeration (the oracle).
+"""
+
+import pytest
+
+from pluss import engine
+from pluss.config import SamplerConfig
+from pluss.models import durbin, floyd_warshall, gramschmidt, trisolv
+
+from tests.oracle import OracleSampler
+from tests.oracle import assert_result_matches_oracle as assert_matches_oracle
+
+FAMILIES = {
+    "trisolv": trisolv,
+    "durbin": durbin,
+    "gramschmidt": gramschmidt,
+    "floyd_warshall": floyd_warshall,
+}
+
+
+@pytest.mark.parametrize("name", sorted(FAMILIES))
+@pytest.mark.parametrize(
+    "cfg", [SamplerConfig(cls=8), SamplerConfig(),
+            SamplerConfig(thread_num=3, chunk_size=5, cls=16)],
+    ids=["cls8", "default", "t3c5cls16"],
+)
+def test_engine_matches_oracle(name, cfg):
+    spec = FAMILIES[name](12)
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+@pytest.mark.parametrize("name", sorted(FAMILIES))
+def test_odd_size_matches_oracle(name):
+    # trip 13 (durbin: parallel trip 12): partial chunks + idle threads
+    spec = FAMILIES[name](13)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+@pytest.mark.parametrize("name", ["durbin", "gramschmidt"])
+def test_windowed_scan_matches_oracle(name):
+    # tiny windows force multi-window scans (durbin: with the clock table)
+    spec = FAMILIES[name](10)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg,
+                          engine.run(spec, cfg, window_accesses=1))
+
+
+def test_durbin_negative_coef_addresses_stay_in_array():
+    # the backwards walk r[k-i-1] must never leave r's line range: every
+    # emitted line id of array r lies inside [base, base+lines)
+    spec = durbin(9)
+    cfg = SamplerConfig(cls=8)
+    o = OracleSampler(spec, cfg)
+    o.run()
+    n_lines = spec.line_counts(cfg)[spec.array_index("r")]
+    for t in range(cfg.thread_num):
+        for line in o.lat[t]["r"]:
+            assert 0 <= line < n_lines
+
+
+def test_trisolv_total_count_closed_form():
+    # per i: 2 head + 4*i loop + 3 tail accesses -> sum = 5n + 4*n(n-1)/2
+    n = 11
+    res = engine.run(trisolv(n), SamplerConfig())
+    assert res.max_iteration_count == 5 * n + 2 * n * (n - 1)
+
+
+@pytest.mark.parametrize("name,n", [("floyd_warshall", 12), ("trisolv", 16)])
+def test_shard_matches_engine(name, n):
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    spec = FAMILIES[name](n)
+    cfg = SamplerConfig(cls=8)
+    want = engine.run(spec, cfg)
+    got = shard_run(spec, cfg, mesh=default_mesh(4))
+    assert got.max_iteration_count == want.max_iteration_count
+    assert (got.noshare_dense == want.noshare_dense).all()
+    assert got.share_list() == want.share_list()
+
+
+def test_durbin_start_point_resume_matches_oracle():
+    # setStartPoint capability on a bounded nest whose parallel loop
+    # starts at 1 (start_point is an iteration VALUE, like the C++
+    # setStartPoint's Iteration argument)
+    spec = durbin(10)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg,
+                          engine.run(spec, cfg, start_point=5),
+                          start_point=5)

@@ -15,16 +15,8 @@ from pluss.config import SamplerConfig
 from pluss.models import syrk_triangular
 from pluss.spec import Loop, LoopNestSpec, Ref, flatten_nest
 
-from tests.oracle import OracleSampler
-
-
-def assert_matches_oracle(spec, cfg, res):
-    o = OracleSampler(spec, cfg).run()
-    assert res.max_iteration_count == o.max_iteration_count
-    assert res.noshare_list() == o.noshare
-    assert res.share_list() == [
-        {k: dict(v) for k, v in h.items()} for h in o.share
-    ]
+from tests.oracle import OracleSampler  # noqa: F401  (spec fixtures below)
+from tests.oracle import assert_result_matches_oracle as assert_matches_oracle
 
 
 @pytest.mark.parametrize("n,cls", [(8, 8), (12, 64), (13, 8)])
