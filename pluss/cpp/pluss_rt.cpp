@@ -23,13 +23,14 @@ Loop parse_loop(const long long* t, long long n, long long& i) {
   lp.step = t[i + 3];
   long long n_body;
   if (tri) {
-    if (i + 8 > n) throw std::runtime_error("spec: truncated TRI LOOP");
+    if (i + 9 > n) throw std::runtime_error("spec: truncated TRI LOOP");
     lp.bounded = true;
     lp.bound_a = t[i + 4];
     lp.bound_b = t[i + 5];
     lp.start_coef = t[i + 6];
-    n_body = t[i + 7];
-    i += 8;
+    lp.bound_level = static_cast<int>(t[i + 7]);
+    n_body = t[i + 8];
+    i += 9;
   } else {
     n_body = t[i + 4];
     i += 5;
@@ -115,9 +116,12 @@ void walk(const Node& node, std::vector<long long>& iv, ThreadState& st,
     return;
   }
   const Loop& lp = *node.loop;
-  // triangular inner loops run a + b*k0 iterations from value
-  // start + start_coef*k0 at parallel index k0
-  long long trip = lp.bounded ? lp.bound_a + lp.bound_b * k0 : lp.trip;
+  // triangular inner loops run a + b*idx iterations, idx = the parallel
+  // index k0 (bound_level 0) or an inner level's index (quad contract:
+  // index == value there, so iv[] serves directly); values start at
+  // start + start_coef*k0
+  long long bref = lp.bound_level == 0 ? k0 : iv[lp.bound_level];
+  long long trip = lp.bounded ? lp.bound_a + lp.bound_b * bref : lp.trip;
   long long start = lp.start + lp.start_coef * k0;
   iv.push_back(0);
   for (long long k = 0; k < trip; ++k) {

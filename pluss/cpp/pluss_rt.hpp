@@ -38,9 +38,14 @@ struct Node;  // LOOP or REF
 struct Loop {
   long long trip = 0, start = 0, step = 1;
   // triangular bound (spec.Loop.bound_coef): effective trip = a + b*k at
-  // parallel index k when `bounded`; first value = start + start_coef*k
+  // effective trip = bound_a + bound_b * (index of the referenced level)
+  // when `bounded` — bound_level 0 is the parallel index k; > 0 names an
+  // enclosing inner level (the quad contract: that level has start=0,
+  // step=1, so its index equals its value in `iv`).  First value =
+  // start + start_coef*k
   bool bounded = false;
   long long bound_a = 0, bound_b = 0, start_coef = 0;
+  int bound_level = 0;
   std::vector<Node> body;
 };
 struct Node {

@@ -58,8 +58,10 @@ from pluss.spec import (
     flatten_nest,
     nest_has_bounds,
     nest_has_varying_start,
+    nest_is_quad,
     nest_iteration_size,
     nest_iteration_size_affine,
+    nest_iteration_sizes,
 )
 
 #: default accesses per scan window (per simulated thread); streams shorter
@@ -638,20 +640,30 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     iters = np.zeros((len(spec.nests), T), np.int64)
     acc = np.zeros((len(spec.nests), T), np.int64)  # true accesses per thread
     for ni, (sched, refs, body, asg, owned, W, NW) in enumerate(geom):
-        n0, n1 = nest_iteration_size_affine(spec.nests[ni])
+        nest_q = nest_is_quad(spec.nests[ni])
+        n0 = n1 = 0
+        if not nest_q:
+            n0, n1 = nest_iteration_size_affine(spec.nests[ni])
         tri = nest_has_bounds(spec.nests[ni])
         tpl = clean = None
         var_refs = refs
         clock = None
         if tri:
-            # triangular nest: per-iteration body size is affine in the
-            # parallel index, so stream positions need a per-thread clock
+            # triangular nest: per-iteration body size varies with the
+            # parallel index (affine — or quadratic under the quad
+            # contract), so stream positions need a per-thread clock
             # table — the exclusive running access count at every (round,
             # chunk-slot) of the thread's stream (invalid slots add 0)
             CS = cfg.chunk_size
             g = owned[:, :, None].astype(np.int64) * CS + np.arange(CS)
             valid = (owned[:, :, None] >= 0) & (g < sched.trip)
-            body_slot = np.where(valid, n0 + n1 * g, 0).reshape(T, -1)
+            if nest_q:
+                size_g = nest_iteration_sizes(
+                    spec.nests[ni], np.arange(sched.trip, dtype=np.int64))
+                gc = np.clip(g, 0, sched.trip - 1)
+                body_slot = np.where(valid, size_g[gc], 0).reshape(T, -1)
+            else:
+                body_slot = np.where(valid, n0 + n1 * g, 0).reshape(T, -1)
             clock = np.concatenate(
                 [np.zeros((T, 1), np.int64), np.cumsum(body_slot, axis=1)],
                 axis=1,
@@ -751,12 +763,13 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         refs_sort = refs
         rpg_hist = None
         static_share = None
-        if tri and build_rowpriv:
+        if tri and build_rowpriv and not nest_q:
             # closed-form groups: row-private arrays (pluss.rowpriv) and
             # D+S sweep pairs (pluss.sweepgroup) become host histogram
             # tables (+ static share lists); their refs leave the device
             # sort entirely.  Both verify per group at plan time and fall
-            # back to the sort path on any mismatch.
+            # back to the sort path on any mismatch.  (Quad nests stay on
+            # the sort path: the group builders' window algebra is affine.)
             from pluss import rowpriv, sweepgroup
 
             refs_sort, rpg_hist = rowpriv.build_rowpriv(
@@ -875,6 +888,10 @@ def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
         ).astype(pos_dtype)
         gk = g.astype(pos_dtype)
         pos = nest_base + start_clock + fr.offset + fr.offset_k * gk
+        if fr.offset_g2:
+            # quad contract: tri(k) = k*(k-1)/2 offset term (invalid slots
+            # may see garbage from negative padded g — masked below)
+            pos = pos + fr.offset_g2 * (gk * (gk - 1) // 2)
     addr = fr.ref.addr_base + fr.addr_coefs[0] * (sched.start + g * sched.step)
     for l in range(1, len(fr.trips)):
         idx = iota(l + 1)
@@ -884,6 +901,9 @@ def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
             pos = pos + idx.astype(pos_dtype) * (
                 fr.pos_strides[l] + fr.pos_strides_k[l] * gk
             )
+        if fr.pos_quads and fr.pos_quads[l]:
+            idxp = idx.astype(pos_dtype)
+            pos = pos + fr.pos_quads[l] * (idxp * (idxp - 1) // 2)
         if fr.bounds and fr.bounds[l] is not None:
             a, b = fr.bounds[l]
             valid = valid & (idx < a + b * g)
@@ -892,6 +912,9 @@ def _ref_window(fr: FlatRef, np_: NestPlan, cfg: SamplerConfig,
             if fr.starts_k and fr.starts_k[l]:
                 start_l = start_l + fr.starts_k[l] * g  # varying loop start
             addr = addr + fr.addr_coefs[l] * (start_l + idx * fr.steps[l])
+    for lv, a, b, rl in fr.inner_bounds or ():
+        # quad contract: idx[lv] < a + b*idx[rl] (rl an inner level)
+        valid = valid & (iota(lv + 1) < a + b * iota(rl + 1))
     line = line_base + addr * cfg.ds // cfg.cls
     span = jnp.full(shape, fr.ref.share_span or 0, jnp.int32)
     return (

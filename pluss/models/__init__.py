@@ -10,8 +10,8 @@ from pluss.models.linalg import (atax, bicg, doitgen, gemver, gesummv,
                                  jacobi2d, mvt)
 from pluss.models.polybench import (correlation, covariance, mm2, mm3,
                                     symm, syr2k, syrk, syrk_triangular, trmm)
-from pluss.models.solvers import (durbin, floyd_warshall, gramschmidt,
-                                  trisolv)
+from pluss.models.solvers import (cholesky, durbin, floyd_warshall,
+                                  gramschmidt, lu, trisolv)
 from pluss.models.stencils import conv2d, fdtd2d, heat3d, stencil3d
 
 REGISTRY = {
@@ -40,6 +40,8 @@ REGISTRY = {
     "durbin": durbin,
     "gramschmidt": gramschmidt,
     "floyd_warshall": floyd_warshall,
+    "cholesky": cholesky,
+    "lu": lu,
 }
 
 __all__ = [
@@ -47,6 +49,6 @@ __all__ = [
     "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d",
     "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm",
     "covariance", "correlation", "trisolv", "durbin", "gramschmidt",
-    "floyd_warshall",
+    "floyd_warshall", "cholesky", "lu",
     "REGISTRY",
 ]

@@ -25,10 +25,13 @@ each other).  Each stresses a distinct corner of the engine:
   parallel-invariant (``path[i][j]`` has no ``k`` term — every simulated
   thread re-touches the same address set each iteration).
 
-Doubly-triangular kernels (cholesky, lu, ludcmp, nussinov) have inner
-trip counts quadratic in the parallel index — outside the affine
-contract by design (``pluss.spec.loop_size_affine`` rejects them); they
-would need the general sort path with value-dependent masks per level.
+``cholesky`` and ``lu`` are DOUBLY-triangular: their per-iteration access
+counts are quadratic in the parallel index (cholesky's ``k < j < i``
+chains two bounds; lu multiplies two parallel-bounded trips).  They ride
+the quad position contract (``Loop.bound_level`` +
+``pluss.spec.flatten_nest_quad``: exact degree-2 closed-form stream
+positions via ``tri(x) = x*(x-1)/2`` terms).  Triply-triangular shapes
+(nussinov's ``k in (i, j)`` cross-bounds) stay out of contract.
 """
 
 from __future__ import annotations
@@ -155,6 +158,94 @@ def gramschmidt(n: int = 128) -> LoopNestSpec:
     return LoopNestSpec(
         name=f"gramschmidt{n}",
         arrays=(("A", n * n), ("R", n * n), ("Q", n * n)),
+        nests=(nest,),
+    )
+
+
+def cholesky(n: int = 128) -> LoopNestSpec:
+    """cholesky, PolyBench 4.2: in-place ``A = L*L^T`` factor (lower part).
+
+    Per parallel iteration ``i``: the ``j < i`` loop (bound (0,1) on the
+    parallel level) runs the DOUBLY-bounded ``k < j`` loop
+    (``bound_coef=(0, 1), bound_level=1``) doing ``A[i][j] -=
+    A[i][k]*A[j][k]`` (loads A_ik, A_jk, A_ij; store A_ij), then
+    ``A[i][j] /= A[j][j]`` (loads A_ij, A_jj; store); the second ``k < i``
+    loop accumulates ``A[i][i] -= A[i][k]^2`` (two operand loads, load
+    A_ii, store); finally ``A[i][i] = sqrt(A[i][i])`` (load + store).
+    Rows ``j``/``k`` below ``i`` recur across parallel iterations —
+    ``A[j][k]`` and ``A[j][j]`` carry the share span; row-``i`` refs are
+    thread-private.
+    """
+    span = share_span_formula(n)
+    a_ij = lambda nm: Ref(nm, "A", addr_terms=((0, n), (1, 1)))
+    a_ii = lambda nm: Ref(nm, "A", addr_terms=((0, n + 1),))
+    kloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), bound_level=1,
+                 body=(
+        Ref("A0", "A", addr_terms=((0, n), (2, 1))),
+        Ref("A1", "A", addr_terms=((1, n), (2, 1)), share_span=span),
+        a_ij("A2"),
+        a_ij("A3"),
+    ))
+    jloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
+        kloop,
+        a_ij("A4"),
+        Ref("A5", "A", addr_terms=((1, n + 1),), share_span=span),
+        a_ij("A6"),
+    ))
+    k2loop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
+        Ref("A7", "A", addr_terms=((0, n), (1, 1))),
+        Ref("A8", "A", addr_terms=((0, n), (1, 1))),
+        a_ii("A9"),
+        a_ii("A10"),
+    ))
+    nest = Loop(trip=n, body=(jloop, k2loop, a_ii("A11"), a_ii("A12")))
+    return LoopNestSpec(
+        name=f"cholesky{n}",
+        arrays=(("A", n * n),),
+        nests=(nest,),
+    )
+
+
+def lu(n: int = 128) -> LoopNestSpec:
+    """lu, PolyBench 4.2: in-place LU decomposition.
+
+    Per parallel iteration ``i``: the ``j < i`` part mirrors cholesky's
+    but multiplies ``A[i][k]*A[k][j]`` (column walk) and divides by the
+    pivot ``A[j][j]``; the second part runs ``j in [i, n)``
+    (``start_coef=1, bound_coef=(n, -1)`` — varying start AND trip) whose
+    body is the ``k < i`` loop doing ``A[i][j] -= A[i][k]*A[k][j]`` — two
+    parallel-bounded loops NESTED (trip product ``(n-i)*i``), the other
+    quadratic shape.  ``A[k][j]``/``A[j][j]`` rows sit below ``i`` and
+    carry the share span.
+    """
+    span = share_span_formula(n)
+    a_ij = lambda nm: Ref(nm, "A", addr_terms=((0, n), (1, 1)))
+    a_kj = lambda nm: Ref(nm, "A", addr_terms=((2, n), (1, 1)),
+                          share_span=span)
+    kloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), bound_level=1,
+                 body=(
+        Ref("A0", "A", addr_terms=((0, n), (2, 1))),
+        a_kj("A1"),
+        a_ij("A2"),
+        a_ij("A3"),
+    ))
+    jloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
+        kloop,
+        a_ij("A4"),
+        Ref("A5", "A", addr_terms=((1, n + 1),), share_span=span),
+        a_ij("A6"),
+    ))
+    k2loop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
+        Ref("A7", "A", addr_terms=((0, n), (2, 1))),
+        a_kj("A8"),
+        a_ij("A9"),
+        a_ij("A10"),
+    ))
+    j2loop = Loop(trip=n, start_coef=1, bound_coef=(n, -1), body=(k2loop,))
+    nest = Loop(trip=n, body=(jloop, j2loop))
+    return LoopNestSpec(
+        name=f"lu{n}",
+        arrays=(("A", n * n),),
         nests=(nest,),
     )
 

@@ -154,12 +154,15 @@ def spec_tokens(spec: LoopNestSpec) -> np.ndarray:
                 toks.extend([depth, coef])
         elif item.bound_coef is not None or item.start_coef:
             # triangular loop: token type 2 carries the (a, b) bound
-            # (effective trip a + b*k at parallel index k) and the start
-            # slope (first value start + start_coef*k); a varying start
-            # with a fixed trip ships the synthetic constant bound (trip, 0)
+            # (effective trip a + b*idx of the referenced level —
+            # bound_level 0 = the parallel index, >0 = an inner level under
+            # the quad contract) and the start slope (first value start +
+            # start_coef*k); a varying start with a fixed trip ships the
+            # synthetic constant bound (trip, 0)
             a, b = item.bound_coef or (item.trip, 0)
             toks.extend([2, item.trip, item.start, item.step,
-                         a, b, item.start_coef, len(item.body)])
+                         a, b, item.start_coef, item.bound_level,
+                         len(item.body)])
             for bd in item.body:
                 emit(bd)
         else:

@@ -30,6 +30,7 @@ Semantics preserved from the reference:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Union
 
 from pluss.config import SamplerConfig, DEFAULT
@@ -80,6 +81,17 @@ class Loop:
     upper-triangular iteration like trmm's ``k in [i+1, m)`` is
     ``start=1, start_coef=1, bound_coef=(m-1, -1)``.  Affects addresses only
     (iteration values), never stream positions.
+
+    ``bound_level``: which enclosing loop's INDEX the bound references —
+    0 (default) is the parallel loop (the contract above); ``l > 0`` makes
+    this a DOUBLY-triangular loop whose trip is ``a + b*idx[l]`` (cholesky's
+    ``k < j`` inside ``j < i`` is ``bound_coef=(0, 1), bound_level=1``).
+    Stream positions then become quadratic in the indices; the closed forms
+    stay exact via ``tri(x) = x*(x-1)/2`` terms (see :func:`flatten_nest_quad`).
+    Restrictions (validated): the referenced level must have
+    ``start=0, step=1, start_coef=0`` (so index == value on every walker),
+    and a loop bounded on an inner level must not itself contain bounded
+    loops (degree <= 2).
     """
 
     trip: int
@@ -88,6 +100,7 @@ class Loop:
     step: int = 1
     bound_coef: tuple[int, int] | None = None
     start_coef: int = 0
+    bound_level: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,6 +165,12 @@ def loop_size_affine(item: Union[Loop, Ref]) -> tuple[int, int]:
         b0 += c0
         b1 += c1
     if item.bound_coef is not None:
+        if item.bound_level:
+            raise ValueError(
+                "loop bounded on an inner level (bound_level > 0): sizes "
+                "are quadratic — use the quad accounting "
+                "(nest_iteration_sizes / flatten_nest_quad)"
+            )
         if b1:
             raise ValueError(
                 "triangular (bounded) loops must not nest inside each other"
@@ -194,10 +213,39 @@ class FlatRef:
     bounds: tuple[tuple[int, int] | None, ...] = ()
     #: per-level start slope: iv[l] = starts[l] + starts_k[l]*k + idx[l]*steps[l]
     starts_k: tuple[int, ...] = ()
+    #: QUAD nests only — per-level coefficient of ``tri(idx[l]) = idx*(idx-1)/2``
+    #: in the position (zero tuple/0 for affine nests, so every consumer may
+    #: evaluate them unconditionally):
+    pos_quads: tuple[int, ...] = ()
+    #: coefficient of ``tri(k)`` in the position offset (k = parallel index)
+    offset_g2: int = 0
+    #: inner-level bound masks: entries ``(level, a, b, ref_level)`` meaning
+    #: ``idx[level] < a + b*idx[ref_level]`` with ``ref_level >= 1`` (the
+    #: parallel-level bounds stay in ``bounds``)
+    inner_bounds: tuple[tuple[int, int, int, int], ...] = ()
+
+
+def nest_is_quad(nest: Loop) -> bool:
+    """True when the nest needs the quadratic-position flatten: a bound
+    referencing an inner level, or bounded loops nested inside each other
+    (their trip PRODUCT is quadratic in the parallel index)."""
+    def bounded_inside_bounded(item) -> bool:
+        if isinstance(item, Ref):
+            return False
+        if item.bound_coef is not None and any(
+                _nest_any(b, lambda l: l.bound_coef is not None)
+                for b in item.body if isinstance(b, Loop)):
+            return True
+        return any(bounded_inside_bounded(b) for b in item.body)
+
+    return nest_has_inner_bounds(nest) or bounded_inside_bounded(nest)
 
 
 def flatten_nest(nest: Loop) -> list[FlatRef]:
-    """Flatten one parallel nest into per-reference affine occurrence specs."""
+    """Flatten one parallel nest into per-reference affine occurrence specs
+    (dispatches to :func:`flatten_nest_quad` for quadratic nests)."""
+    if nest_is_quad(nest):
+        return flatten_nest_quad(nest)
     out: list[FlatRef] = []
     if nest.bound_coef is not None or nest.start_coef:
         raise ValueError(
@@ -268,12 +316,74 @@ def flatten_nest(nest: Loop) -> list[FlatRef]:
 
 def nest_iteration_size(nest: Loop) -> int:
     """MAX accesses per iteration of the nest's outermost (parallel) loop
-    (for bounded nests: the affine size evaluated at its worst parallel
-    index — used for static shapes and window sizing)."""
+    (for bounded nests: the size evaluated at its worst parallel index —
+    used for static shapes and window sizing)."""
+    if nest_is_quad(nest):
+        import numpy as np
+
+        return int(nest_iteration_sizes(
+            nest, np.arange(nest.trip, dtype=np.int64)).max())
     n0, n1 = nest_iteration_size_affine(nest)
     if n1 == 0:
         return n0
     return max(n0, n0 + n1 * (nest.trip - 1))
+
+
+def nest_iteration_sizes(nest: Loop, gs) -> "np.ndarray":
+    """EXACT accesses per parallel iteration at parallel indices ``gs`` —
+    valid for any supported nest (affine or quad).  The quad clock tables
+    are built from this (the affine fast path keeps the ``n0 + n1*g``
+    closed form).  The full [trip] vector is computed once per nest and
+    memoized (one engine.run consults it from geometry sizing, the clock
+    table, and sampling)."""
+    import numpy as np
+
+    return _nest_sizes_full(nest)[np.asarray(gs, np.int64)]
+
+
+@functools.lru_cache(maxsize=128)
+def _nest_sizes_full(nest: Loop) -> "np.ndarray":
+    import numpy as np
+
+    gs = np.arange(nest.trip, dtype=np.int64)
+
+    def size(item, env: dict, level: int) -> "np.ndarray | int":
+        # env maps enclosing level -> index value (np array over gs or int);
+        # ``level`` is the depth ``item`` itself sits at (refs: unused)
+        if isinstance(item, Ref):
+            return 1
+        if item.bound_coef is None:
+            trips = item.trip
+        else:
+            a, b = item.bound_coef
+            trips = a + b * np.asarray(env[item.bound_level])
+        if not _any_child_bounded_on(item, level):
+            body = sum(size(b, {**env, level: 0}, level + 1)
+                       for b in item.body)
+            return trips * body
+        # some descendant's trip references THIS loop's index: sum per-t
+        tmax = int(np.max(trips))
+        total = np.zeros_like(gs)
+        for t in range(tmax):
+            live = t < trips
+            body = sum(size(b, {**env, level: t}, level + 1)
+                       for b in item.body)
+            total = total + np.where(live, body, 0)
+        return total
+
+    body = sum(size(b, {0: gs}, 1) for b in nest.body)
+    return np.broadcast_to(np.asarray(body, np.int64), gs.shape).copy()
+
+
+def _any_child_bounded_on(loop: Loop, level: int) -> bool:
+    """True when any loop in ``loop``'s body tree is bounded on ``level``."""
+    def walk(item) -> bool:
+        if isinstance(item, Ref):
+            return False
+        return (item.bound_coef is not None and item.bound_level == level) \
+            or any(walk(b) for b in item.body)
+
+    return any(walk(b) for b in loop.body)
 
 
 def _nest_any(nest: Loop, pred) -> bool:
@@ -297,12 +407,267 @@ def nest_has_bounds(nest: Loop) -> bool:
     return _nest_any(nest, lambda l: l.bound_coef is not None)
 
 
+def nest_has_inner_bounds(nest: Loop) -> bool:
+    """True when any loop's bound references an INNER level (``bound_level
+    > 0``) — the doubly-triangular (quadratic-position) contract.  Such
+    nests flatten via :func:`flatten_nest_quad` and always take the
+    engine's clock-table sort path."""
+    return _nest_any(
+        nest,
+        lambda l: l.bound_coef is not None and l.bound_level > 0,
+    )
+
+
 def nest_has_varying_start(nest: Loop) -> bool:
     """True when any loop in the nest has a nonzero ``start_coef`` — such
     nests break the template path's shift-invariance even when their trip
     counts are constant, because iteration VALUES (addresses) shift with
     the parallel index."""
     return _nest_any(nest, lambda l: bool(l.start_coef))
+
+
+def _tri_of_const(c: int) -> int:
+    return c * (c - 1) // 2
+
+
+class _QuadContractError(ValueError):
+    def __init__(self, what: str):
+        super().__init__(
+            f"outside the quadratic position contract: {what} (positions "
+            "must stay degree <= 2 with integer closed forms)"
+        )
+
+
+def _fadd(a: dict, b: dict) -> dict:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return {k: v for k, v in out.items() if v}
+
+
+def _fscale(f: dict, c: int) -> dict:
+    return {k: v * c for k, v in f.items() if v * c}
+
+
+def _fsum_over(f: dict, tdesc) -> dict:
+    """``sum_{t in [0, T)} f(t, ...)`` over the position-form monomial basis
+    ``{1, g, tri(g)='g2', idx_l=('i',l), tri(idx_l)=('t',l), idx_l*g=('ig',l)}``.
+
+    ``tdesc``: ``('const', c)`` | ``('g', a, b)`` (T = a + b*g) |
+    ``('idx', m, a, b)`` (T = a + b*idx_m).  The summand references the
+    summation variable via the ``self_level`` keys, split off below.
+    Anything that would leave the basis (degree 3, inner-inner crosses)
+    raises :class:`_QuadContractError` — exactness is never approximated.
+    """
+    kind = tdesc[0]
+    self_l = tdesc[1] if kind == "idx" else None
+    # split f = A + B*t (+ C*tri(t) + D*t*g, each legal only case-by-case)
+    A = dict(f)
+    B = A.pop(("i", "self"), 0)
+    C = A.pop(("t", "self"), 0)
+    D = A.pop(("ig", "self"), 0)
+    if C:
+        raise _QuadContractError("summing a tri(t) term (degree 3)")
+
+    def tri_of_T() -> dict:
+        # tri(a + b*v) = b^2*tri(v) + (b*(b-1)//2 + a*b)*v + tri(a)
+        if kind == "const":
+            return {"1": _tri_of_const(tdesc[1])}
+        a, b = tdesc[-2], tdesc[-1]
+        lin = b * (b - 1) // 2 + a * b
+        vkey_l, vkey_t = (("g", "g2") if kind == "g"
+                          else (("i", self_l), ("t", self_l)))
+        return {vkey_t: b * b, vkey_l: lin, "1": _tri_of_const(a)}
+
+    def times_T(form: dict) -> dict:
+        # form * (a + b*v); form holds NO self keys (split off above)
+        if kind == "const":
+            return _fscale(form, tdesc[1])
+        a, b = tdesc[-2], tdesc[-1]
+        res = _fscale(form, a)
+        if b == 0:
+            return res
+        for k, v in form.items():
+            c = v * b
+            if k == "1":
+                lift = {("g" if kind == "g" else ("i", self_l)): c}
+            elif kind == "g" and k == "g":
+                # g * g = 2*tri(g) + g
+                lift = {"g2": 2 * c, "g": c}
+            elif kind == "g" and isinstance(k, tuple) and k[0] == "i":
+                lift = {("ig", k[1]): c}
+            elif kind == "idx" and k == "g":
+                lift = {("ig", self_l): c}
+            elif kind == "idx" and k == ("i", self_l):
+                lift = {("t", self_l): 2 * c, ("i", self_l): c}
+            else:
+                raise _QuadContractError(f"product {k} * bound variable")
+            res = _fadd(res, lift)
+        return res
+
+    out = _fadd(times_T(A), _fscale(tri_of_T(), B))
+    if D:
+        # sum_{t<T} D*t*g = D*g*tri(T): integral only for a constant T
+        if kind != "const":
+            raise _QuadContractError("t*g term under a varying bound")
+        out = _fadd(out, {"g": D * _tri_of_const(tdesc[1])})
+    return out
+
+
+def _self_keys(f: dict, level: int) -> dict:
+    """Rekey ``level``'s monomials to the ``'self'`` markers _fsum_over
+    splits on (the caller is about to sum over that level's index)."""
+    ren = {("i", level): ("i", "self"), ("t", level): ("t", "self"),
+           ("ig", level): ("ig", "self")}
+    return {ren.get(k, k): v for k, v in f.items()}
+
+
+def flatten_nest_quad(nest: Loop) -> list[FlatRef]:
+    """Quad-contract flatten: same :class:`FlatRef` output as
+    :func:`flatten_nest` plus the degree-2 fields (``pos_quads``,
+    ``offset_g2``, ``inner_bounds``).  Within-iteration positions are
+    assembled symbolically over the form basis above, so a loop bounded on
+    an INNER level (``bound_level > 0`` — cholesky's ``k < j < i``) gets
+    exact closed-form stream positions without any state machine.
+
+    Validated restrictions (each raises): the parallel loop rectangular
+    (as before); a bound may reference one enclosing level; the referenced
+    inner level must have ``start=0, step=1, start_coef=0`` (index ==
+    value on every walker — oracle and native reuse their value vectors);
+    loops bounded on an inner level must not contain bounded loops.
+    Varying starts (``start_coef``) remain fully supported anywhere else:
+    they shift iteration VALUES (addresses, via ``FlatRef.starts_k``),
+    never stream positions, so the position algebra is untouched by them.
+    Shapes whose positions would leave the degree-2 basis (triple bound
+    chains, nussinov-style cross bounds) raise at plan time rather than
+    ever emitting approximate positions.
+    """
+    out: list[FlatRef] = []
+    if nest.bound_coef is not None or nest.start_coef:
+        raise ValueError(
+            "the parallel (outermost) loop must be rectangular; bound_coef/"
+            "start_coef are for inner loops"
+        )
+
+    def tdesc_of(loop: Loop, level: int, chain: list[Loop]):
+        if loop.bound_coef is None:
+            return ("const", loop.trip)
+        a, b = loop.bound_coef
+        if loop.bound_level == 0:
+            return ("g", a, b)
+        m = loop.bound_level
+        if not 0 < m < level:
+            raise ValueError(
+                f"bound_level {m} must name an enclosing loop "
+                f"(this loop sits at depth {level})"
+            )
+        ref = chain[m]
+        if ref.start or ref.step != 1 or ref.start_coef:
+            raise _QuadContractError(
+                "the bound-referenced level must have start=0, step=1, "
+                "start_coef=0 (index == value)"
+            )
+        if any(_nest_any(b, lambda l: l.bound_coef is not None)
+               for b in loop.body if isinstance(b, Loop)):
+            raise _QuadContractError(
+                "a loop bounded on an inner level must not contain "
+                "bounded loops"
+            )
+        return ("idx", m, a, b)
+
+    def size_form(item, level: int, chain: list[Loop]) -> dict:
+        if isinstance(item, Ref):
+            return {"1": 1}
+        body = {}
+        for b in item.body:
+            body = _fadd(body, size_form(b, level + 1, chain + [item]))
+        return _fsum_over(_self_keys(body, level),
+                          tdesc_of(item, level, chain))
+
+    def static_max_index(level: int, chain: list[Loop]) -> int:
+        """Largest index the loop at ``level`` can reach (static trips are
+        declared maxima, so trip-1 bounds every bound chain)."""
+        return chain[level].trip - 1
+
+    def check_bound(loop: Loop, level: int, chain: list[Loop]) -> None:
+        a, b = loop.bound_coef
+        if not 0 <= loop.bound_level < level:
+            raise ValueError(
+                f"bound_level {loop.bound_level} must name an enclosing "
+                f"loop (this loop sits at depth {level})"
+            )
+        hi = static_max_index(loop.bound_level, chain) \
+            if loop.bound_level else nest.trip - 1
+        ends = (a, a + b * hi)
+        if min(ends) < 0 or max(ends) > loop.trip:
+            raise ValueError(
+                f"bound {loop.bound_coef} leaves [0, trip={loop.trip}] over "
+                f"referenced indices [0, {hi}]"
+            )
+
+    def emit(item: Ref, chain: list[Loop], form: dict) -> None:
+        d = len(chain)
+        coefs = [0] * d
+        for depth, coef in item.addr_terms:
+            if depth >= d:
+                raise ValueError(
+                    f"ref {item.name}: addr term depth {depth} exceeds "
+                    f"loop chain depth {d}"
+                )
+            coefs[depth] += coef
+        bounds = []
+        inner = []
+        for l, lp in enumerate(chain):
+            if lp.bound_coef is None or lp.bound_level == 0:
+                bounds.append(lp.bound_coef)
+            else:
+                bounds.append(None)
+                inner.append((l, *lp.bound_coef, lp.bound_level))
+        leftovers = set(form) - {"1", "g", "g2"} - {
+            ("i", l) for l in range(1, d)} - {("t", l) for l in range(1, d)
+        } - {("ig", l) for l in range(1, d)}
+        if leftovers:
+            raise _QuadContractError(f"unplaced position terms {leftovers}")
+        out.append(FlatRef(
+            ref=item,
+            trips=tuple(l.trip for l in chain),
+            starts=tuple(l.start for l in chain),
+            steps=tuple(l.step for l in chain),
+            pos_strides=tuple(form.get(("i", l), 0) for l in range(d)),
+            offset=form.get("1", 0),
+            addr_coefs=tuple(coefs),
+            pos_strides_k=tuple(form.get(("ig", l), 0) for l in range(d)),
+            offset_k=form.get("g", 0),
+            bounds=tuple(bounds),
+            starts_k=tuple(l.start_coef for l in chain),
+            pos_quads=tuple(form.get(("t", l), 0) for l in range(d)),
+            offset_g2=form.get("g2", 0),
+            inner_bounds=tuple(inner),
+        ))
+
+    def walk(loop: Loop, chain: list[Loop], off: dict) -> None:
+        chain = chain + [loop]
+        level = len(chain) - 1
+        if level > 0:
+            if loop.bound_coef is not None:
+                check_bound(loop, level, chain)
+            # prefix of earlier iterations of THIS level: sum the body's
+            # one-iteration size over t in [0, idx_level)
+            body = {}
+            for b in loop.body:
+                body = _fadd(body, size_form(b, level + 1, chain))
+            off = _fadd(off, _fsum_over(_self_keys(body, level),
+                                        ("idx", level, 0, 1)))
+        b_off: dict = {}
+        for item in loop.body:
+            if isinstance(item, Ref):
+                emit(item, chain, _fadd(off, b_off))
+                b_off = _fadd(b_off, {"1": 1})
+            else:
+                walk(item, chain, _fadd(off, b_off))
+                b_off = _fadd(b_off, size_form(item, level + 1, chain))
+    walk(nest, [], {})
+    return out
 
 
 def nest_iteration_size_affine(nest: Loop) -> tuple[int, int]:

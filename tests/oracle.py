@@ -84,14 +84,18 @@ class OracleSampler:
         else:
             trip, start = item.trip, item.start
             if item.bound_coef is not None or item.start_coef:
-                # triangular inner loop: effective trip a + b*k, start
-                # value start + start_coef*k, with k the parallel INDEX of
-                # this nest iteration (spec.Loop.bound_coef/start_coef)
+                # triangular inner loop: effective trip a + b*idx of the
+                # referenced level — the parallel INDEX by default
+                # (spec.Loop.bound_coef/start_coef), or an inner level's
+                # index under the quad contract (spec.Loop.bound_level;
+                # index == value there, validated by flatten_nest_quad)
                 pstart, pstep = self._pnest
                 k0 = (ivs[0] - pstart) // pstep
                 if item.bound_coef is not None:
                     a, b = item.bound_coef
-                    trip = a + b * k0
+                    ref_idx = k0 if item.bound_level == 0 \
+                        else ivs[item.bound_level]
+                    trip = a + b * ref_idx
                 start = start + item.start_coef * k0
             for i in range(trip):
                 v = start + i * item.step

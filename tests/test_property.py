@@ -35,21 +35,33 @@ def specs(draw):
     ref_id = [0]
 
     def gen_loop(depth: int, trips: list[int], max_ivs: list[int],
-                 inside_bounded: bool = False) -> Loop:
+                 bounded_depth: int = 0, start_coefs: list[int] = [],
+                 no_bounds: bool = False) -> Loop:
         trip = draw(st.integers(2, 6))
-        trips = trips + [trip]
         # triangular inner loops (Loop.bound_coef): effective trip a + b*k
-        # over the parallel index k; never at the root, never nested inside
-        # another bounded loop, and always within [0, trip]
+        # over the parallel index k — never at the root, within [0, trip].
+        # ONE bounded ancestor is allowed (the quad contract: lu's nested
+        # parallel-bounded trips); two would leave degree 2.  bound_level
+        # > 0 (cholesky's k < j) references an enclosing inner level with
+        # index == value and forbids bounds below itself.
         bound = None
+        bound_level = 0
         start_coef = 0
-        if depth >= 1 and not inside_bounded and draw(st.booleans()):
-            ptrip = trips[0]
-            b = draw(st.sampled_from([1, -1]))
-            if b == 1 and trip >= ptrip:
-                bound = (draw(st.integers(1, trip - (ptrip - 1))), 1)
-            elif b == -1 and trip >= ptrip - 1:
-                bound = (draw(st.integers(ptrip - 1, trip)), -1)
+        if depth >= 1 and not no_bounds and draw(st.booleans()):
+            inner_ok = [l for l in range(1, depth)
+                        if start_coefs[l] == 0]
+            if depth >= 2 and inner_ok and draw(st.booleans()):
+                bound_level = draw(st.sampled_from(inner_ok))
+                bound = (0, 1)
+                trip = max(trips[bound_level] - 1, 1)
+            elif bounded_depth <= 1:
+                ptrip = trips[0]
+                b = draw(st.sampled_from([1, -1]))
+                if b == 1 and trip >= ptrip:
+                    bound = (draw(st.integers(1, trip - (ptrip - 1))), 1)
+                elif b == -1 and trip >= ptrip - 1:
+                    bound = (draw(st.integers(ptrip - 1, trip)), -1)
+        trips = trips + [trip]
         if depth >= 1:
             # varying start (trmm-style k in [i+1, ...)), with or without a
             # varying trip; shifts iteration VALUES (addresses), not counts
@@ -61,8 +73,12 @@ def specs(draw):
         for _ in range(n_items):
             deeper = depth < 2 and draw(st.booleans())
             if deeper:
-                body.append(gen_loop(depth + 1, trips, max_ivs,
-                                     inside_bounded or bound is not None))
+                body.append(gen_loop(
+                    depth + 1, trips, max_ivs,
+                    bounded_depth + (1 if bound is not None
+                                     and bound_level == 0 else 0),
+                    start_coefs + [start_coef],
+                    no_bounds or bound_level > 0))
             else:
                 nm = names[draw(st.integers(0, n_arrays - 1))]
                 n_terms = draw(st.integers(0, len(trips)))
@@ -85,10 +101,12 @@ def specs(draw):
                 maxes[nm] = max(maxes[nm], _max_addr(ref, max_ivs))
                 body.append(ref)
         return Loop(trip=trip, body=tuple(body), bound_coef=bound,
-                    start_coef=start_coef)
+                    start_coef=start_coef, bound_level=bound_level)
 
     for _ in range(n_nests):
-        nests.append(gen_loop(0, [], []))
+        # start_coefs accumulates one entry per ancestor level as gen_loop
+        # recurses (level l's coef lands at index l)
+        nests.append(gen_loop(0, [], [], 0, []))
     arrays = tuple((nm, maxes[nm] + 1) for nm in names)
     return LoopNestSpec(name="prop", arrays=arrays, nests=tuple(nests))
 

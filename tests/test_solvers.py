@@ -15,7 +15,8 @@ import pytest
 
 from pluss import engine
 from pluss.config import SamplerConfig
-from pluss.models import durbin, floyd_warshall, gramschmidt, trisolv
+from pluss.models import (cholesky, durbin, floyd_warshall, gramschmidt,
+                          lu, trisolv)
 
 from tests.oracle import OracleSampler
 from tests.oracle import assert_result_matches_oracle as assert_matches_oracle
@@ -87,6 +88,190 @@ def test_shard_matches_engine(name, n):
     assert got.max_iteration_count == want.max_iteration_count
     assert (got.noshare_dense == want.noshare_dense).all()
     assert got.share_list() == want.share_list()
+
+
+QUAD = {"cholesky": cholesky, "lu": lu}
+
+
+@pytest.mark.parametrize("name", sorted(QUAD))
+@pytest.mark.parametrize(
+    "cfg", [SamplerConfig(cls=8), SamplerConfig(),
+            SamplerConfig(thread_num=3, chunk_size=5, cls=16)],
+    ids=["cls8", "default", "t3c5cls16"],
+)
+def test_quad_engine_matches_oracle(name, cfg):
+    spec = QUAD[name](12)
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+@pytest.mark.parametrize("name", sorted(QUAD))
+def test_quad_odd_size_matches_oracle(name):
+    spec = QUAD[name](13)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+@pytest.mark.parametrize("name", sorted(QUAD))
+def test_quad_windowed_scan_matches_oracle(name):
+    spec = QUAD[name](10)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg,
+                          engine.run(spec, cfg, window_accesses=1))
+
+
+@pytest.mark.parametrize("name", sorted(QUAD))
+def test_quad_seq_and_resume_match_oracle(name):
+    spec = QUAD[name](10)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg, backend="seq"))
+    assert_matches_oracle(spec, cfg, engine.run(spec, cfg, start_point=5),
+                          start_point=5)
+
+
+@pytest.mark.parametrize("name", sorted(QUAD))
+def test_quad_shard_matches_engine(name):
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    spec = QUAD[name](12)
+    cfg = SamplerConfig(cls=8)
+    want = engine.run(spec, cfg)
+    got = shard_run(spec, cfg, mesh=default_mesh(4))
+    assert got.max_iteration_count == want.max_iteration_count
+    assert (got.noshare_dense == want.noshare_dense).all()
+    assert got.share_list() == want.share_list()
+
+
+def _brute_positions(nest):
+    """Program-order positions of one parallel iteration — the independent
+    check of flatten_nest_quad's degree-2 closed forms."""
+    from pluss.spec import Ref
+
+    out = {}
+
+    def trip_of(loop, g, idxs):
+        if loop.bound_coef is None:
+            return loop.trip
+        a, b = loop.bound_coef
+        ref = g if loop.bound_level == 0 else idxs[loop.bound_level - 1]
+        return a + b * ref
+
+    def walk(item, g, idxs, pos):
+        if isinstance(item, Ref):
+            out[(item.name, tuple(idxs))] = pos
+            return pos + 1
+        for t in range(trip_of(item, g, idxs)):
+            for b in item.body:
+                pos = walk(b, g, idxs + [t], pos)
+        return pos
+
+    def run(g):
+        out.clear()
+        pos = 0
+        for b in nest.body:
+            pos = walk(b, g, [], pos)
+        return dict(out)
+
+    return run
+
+
+@pytest.mark.parametrize("name", sorted(QUAD))
+def test_quad_flatten_positions_exact(name):
+    from pluss.spec import flatten_nest, nest_is_quad
+
+    spec = QUAD[name](9)
+    nest = spec.nests[0]
+    assert nest_is_quad(nest)
+    frs = flatten_nest(nest)
+    brute = _brute_positions(nest)
+    tri = lambda x: x * (x - 1) // 2
+    for g in range(nest.trip):
+        want = brute(g)
+        got = {}
+        for fr in frs:
+            def occs(l, idxs):
+                if l == len(fr.trips):
+                    pos = fr.offset + fr.offset_k * g \
+                        + fr.offset_g2 * tri(g)
+                    for lv in range(1, len(fr.trips)):
+                        pos += idxs[lv - 1] * (
+                            fr.pos_strides[lv] + fr.pos_strides_k[lv] * g)
+                        if fr.pos_quads:
+                            pos += fr.pos_quads[lv] * tri(idxs[lv - 1])
+                    got[(fr.ref.name, tuple(idxs))] = pos
+                    return
+                t_eff = fr.trips[l]
+                if fr.bounds and fr.bounds[l] is not None:
+                    a, b = fr.bounds[l]
+                    t_eff = a + b * g
+                for lv, a, b, rl in fr.inner_bounds or ():
+                    if lv == l:
+                        t_eff = a + b * idxs[rl - 1]
+                for t in range(t_eff):
+                    occs(l + 1, idxs + [t])
+            occs(1, [])
+        assert got == want, (name, g)
+
+
+def test_quad_iteration_sizes_exact():
+    import numpy as np
+
+    from pluss.spec import nest_iteration_sizes
+
+    for build in (cholesky, lu):
+        nest = build(11).nests[0]
+        brute = _brute_positions(nest)
+        want = [len(brute(g)) for g in range(nest.trip)]
+        got = nest_iteration_sizes(nest, np.arange(nest.trip))
+        assert got.tolist() == want, build.__name__
+
+
+def test_quad_contract_rejections():
+    from pluss.spec import Loop, Ref, flatten_nest
+
+    r = lambda: Ref("R", "A", addr_terms=((0, 1),))
+    # triply-triangular: a bounded loop inside a bounded-on-inner loop
+    with pytest.raises(ValueError, match="must not contain bounded"):
+        flatten_nest(Loop(trip=4, body=(
+            Loop(trip=4, bound_coef=(0, 1), body=(
+                Loop(trip=4, bound_coef=(0, 1), bound_level=1, body=(
+                    Loop(trip=4, bound_coef=(0, 1), body=(r(),)),
+                )),
+            )),
+        )))
+    # bound_level must name an enclosing loop
+    with pytest.raises(ValueError, match="enclosing"):
+        flatten_nest(Loop(trip=4, body=(
+            Loop(trip=4, bound_coef=(0, 1), bound_level=2, body=(r(),)),
+        )))
+    # the referenced level must have index == value (start=0, step=1)
+    with pytest.raises(ValueError, match="index == value"):
+        flatten_nest(Loop(trip=4, body=(
+            Loop(trip=4, start=1, body=(
+                Loop(trip=4, bound_coef=(0, 1), bound_level=1,
+                     body=(r(),)),
+            )),
+        )))
+
+
+def test_quad_native_matches_engine():
+    from pluss import native
+    from pluss.config import DEFAULT
+
+    for build in (cholesky, lu):
+        spec = build(12)
+        want = engine.run(spec, DEFAULT)
+        got = native.run(spec, DEFAULT)
+        assert got.max_iteration_count == want.max_iteration_count
+        assert got.noshare_list() == want.noshare_list()
+        assert got.share_list() == want.share_list()
+
+
+def test_cholesky_total_count_closed_form():
+    # per i: sum_{j<i}(4j+3) + 4i + 2 = 2i^2 + 5i + 2
+    n = 10
+    res = engine.run(cholesky(n), SamplerConfig())
+    want = sum(2 * i * i + 5 * i + 2 for i in range(n))
+    assert res.max_iteration_count == want
 
 
 def test_durbin_start_point_resume_matches_oracle():

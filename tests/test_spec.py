@@ -19,7 +19,8 @@ def interpret(nest: Loop):
         trip, start = item.trip, item.start
         if item.bound_coef is not None:
             a, b = item.bound_coef
-            trip = a + b * k0
+            ref_idx = k0 if item.bound_level == 0 else ivs[item.bound_level]
+            trip = a + b * ref_idx
         if item.start_coef:
             start = start + item.start_coef * k0
         for i in range(trip):
@@ -35,31 +36,40 @@ def flat_positions(nest: Loop):
     """Evaluate every FlatRef's affine (pos, addr) over its valid index grid.
 
     Mirrors the engine's position model exactly: the parallel level
-    contributes the running clock (quadratic for triangular nests — the
+    contributes the running clock (growing for triangular/quad nests — the
     engine's per-thread clock table); inner levels contribute their
-    affine-in-k strides; bounded levels are masked by ``idx < a + b*k``.
+    affine-in-k strides plus the quad contract's ``tri(idx)`` terms;
+    bounded levels are masked by ``idx < a + b*k`` (or an inner level's
+    index, ``FlatRef.inner_bounds``).
     """
     import itertools
 
-    from pluss.spec import nest_iteration_size_affine
+    from pluss.spec import nest_iteration_sizes
 
-    n0, n1 = nest_iteration_size_affine(nest)
+    sizes = nest_iteration_sizes(nest, range(nest.trip))
     clock = [0]
     for k in range(nest.trip):
-        clock.append(clock[-1] + n0 + n1 * k)
+        clock.append(clock[-1] + int(sizes[k]))
 
+    tri = lambda x: x * (x - 1) // 2
     entries = {}
     for fr in flatten_nest(nest):
         sk = fr.pos_strides_k or (0,) * len(fr.trips)
+        qd = fr.pos_quads or (0,) * len(fr.trips)
         bounds = fr.bounds or (None,) * len(fr.trips)
         for idxs in itertools.product(*(range(t) for t in fr.trips)):
             k = idxs[0]
             if any(b is not None and not idxs[l] < b[0] + b[1] * k
                    for l, b in enumerate(bounds)):
                 continue
-            pos = clock[k] + fr.offset + fr.offset_k * k + sum(
-                i * (s0 + s1 * k)
-                for i, s0, s1 in zip(idxs[1:], fr.pos_strides[1:], sk[1:])
+            if any(not idxs[lv] < a + b * idxs[rl]
+                   for lv, a, b, rl in fr.inner_bounds or ()):
+                continue
+            pos = clock[k] + fr.offset + fr.offset_k * k \
+                + fr.offset_g2 * tri(k) + sum(
+                i * (s0 + s1 * k) + q * tri(i)
+                for i, s0, s1, q in zip(idxs[1:], fr.pos_strides[1:],
+                                        sk[1:], qd[1:])
             )
             stk = fr.starts_k or (0,) * len(fr.trips)
             ivs = tuple(st + sc * k + i * sp for st, sc, i, sp
@@ -71,13 +81,13 @@ def flat_positions(nest: Loop):
 
 @pytest.mark.parametrize("name", list(REGISTRY))
 def test_flatten_matches_interpretation(name):
-    from pluss.spec import nest_iteration_size_affine
+    from pluss.spec import nest_iteration_sizes
 
     spec = REGISTRY[name](8 if name != "stencil3d" else 6)
     for nest in spec.nests:
         seq = interpret(nest)
-        n0, n1 = nest_iteration_size_affine(nest)
-        assert len(seq) == sum(n0 + n1 * k for k in range(nest.trip))
+        assert len(seq) == int(nest_iteration_sizes(
+            nest, range(nest.trip)).sum())
         flat = flat_positions(nest)
         assert len(flat) == len(seq)
         for pos, (ref, ivs) in enumerate(seq):

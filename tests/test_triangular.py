@@ -263,14 +263,22 @@ def test_validation_errors():
         flatten_nest(Loop(trip=4, bound_coef=(1, 1), body=(
             Ref("X0", "X", addr_terms=((0, 4),)),
         )))
-    with pytest.raises(ValueError, match="nest inside"):
-        flatten_nest(Loop(trip=4, body=(
+    # bounded-inside-bounded no longer rejects: it dispatches to the quad
+    # flatten (round 4 — spec.nest_is_quad); the AFFINE accounting alone
+    # still refuses it, which loop_size_affine pins
+    from pluss.spec import loop_size_affine, nest_is_quad
+
+    nested = Loop(trip=4, body=(
+        Loop(trip=4, bound_coef=(1, 1), body=(
             Loop(trip=4, bound_coef=(1, 1), body=(
-                Loop(trip=4, bound_coef=(1, 1), body=(
-                    Ref("X0", "X", addr_terms=((0, 4),)),
-                )),
+                Ref("X0", "X", addr_terms=((0, 4),)),
             )),
-        )))
+        )),
+    ))
+    assert nest_is_quad(nested)
+    assert len(flatten_nest(nested)) == 1
+    with pytest.raises(ValueError, match="nest inside|quad"):
+        loop_size_affine(nested.body[0])
     with pytest.raises(ValueError, match="leaves"):
         # bound exceeds the declared static trip at the last parallel index
         flatten_nest(Loop(trip=4, body=(
