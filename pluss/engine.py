@@ -61,7 +61,7 @@ from pluss.spec import (
     nest_is_quad,
     nest_iteration_size,
     nest_iteration_size_affine,
-    nest_iteration_sizes,
+    slot_sizes,
 )
 
 #: default accesses per scan window (per simulated thread); streams shorter
@@ -518,6 +518,12 @@ def _tri_buckets(refs, owned: np.ndarray, sched, cfg: SamplerConfig,
                 a, b = bd
                 eff = max(a + b * g_lo, a + b * g_hi, 0)
                 trips[l] = int(max(1, min(fr.trips[l], eff)))
+            # quad contract: an inner-bounded level clamps transitively —
+            # cholesky's k < j with j already clamped to the bucket's
+            # g-range caps k at the same bound (idx_rl <= trips[rl]-1)
+            for lv, a, b, rl in fr.inner_bounds or ():
+                eff = max(a, a + b * (trips[rl] - 1), 0)
+                trips[lv] = int(max(1, min(trips[lv], eff)))
             brefs.append(dataclasses.replace(fr, trips=tuple(trips)))
         out.append((ws, tuple(brefs)))
     # degenerate split (every bucket at the global max) buys nothing
@@ -654,16 +660,9 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             # contract), so stream positions need a per-thread clock
             # table — the exclusive running access count at every (round,
             # chunk-slot) of the thread's stream (invalid slots add 0)
-            CS = cfg.chunk_size
-            g = owned[:, :, None].astype(np.int64) * CS + np.arange(CS)
-            valid = (owned[:, :, None] >= 0) & (g < sched.trip)
-            if nest_q:
-                size_g = nest_iteration_sizes(
-                    spec.nests[ni], np.arange(sched.trip, dtype=np.int64))
-                gc = np.clip(g, 0, sched.trip - 1)
-                body_slot = np.where(valid, size_g[gc], 0).reshape(T, -1)
-            else:
-                body_slot = np.where(valid, n0 + n1 * g, 0).reshape(T, -1)
+            slot, valid = slot_sizes(spec.nests[ni], owned, sched.trip,
+                                     cfg.chunk_size)
+            body_slot = slot.reshape(T, -1)
             clock = np.concatenate(
                 [np.zeros((T, 1), np.int64), np.cumsum(body_slot, axis=1)],
                 axis=1,

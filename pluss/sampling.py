@@ -133,21 +133,12 @@ def _auto_context(np_, cfg: SamplerConfig) -> int:
 
 def _window_counts(np_, cfg: SamplerConfig, nest) -> np.ndarray:
     """[T, NW] true accesses of each thread-window (the walk-cost unit);
-    per-iteration sizes cover rectangular (slope 0), triangular and quad
-    nests uniformly (spec.nest_iteration_sizes is exact for all three)."""
-    from pluss.spec import nest_is_quad, nest_iteration_size_affine, \
-        nest_iteration_sizes
+    per-slot sizes cover rectangular, triangular and quad nests uniformly
+    (spec.slot_sizes — the same rule the engine's clock tables use)."""
+    from pluss.spec import slot_sizes
 
     T = np_.owned.shape[0]
-    CS = cfg.chunk_size
-    g = np_.owned[:, :, None].astype(np.int64) * CS + np.arange(CS)
-    valid = (np_.owned[:, :, None] >= 0) & (g < np_.sched.trip)
-    if nest_is_quad(nest):
-        size_g = nest_iteration_sizes(nest, np.arange(np_.sched.trip))
-        slot = np.where(valid, size_g[np.clip(g, 0, np_.sched.trip - 1)], 0)
-    else:
-        n0, n1 = nest_iteration_size_affine(nest)
-        slot = np.where(valid, n0 + n1 * g, 0)
+    slot, _ = slot_sizes(nest, np_.owned, np_.sched.trip, cfg.chunk_size)
     return slot.reshape(T, np_.n_windows, -1).sum(axis=2)
 
 

@@ -341,6 +341,25 @@ def nest_iteration_sizes(nest: Loop, gs) -> "np.ndarray":
     return _nest_sizes_full(nest)[np.asarray(gs, np.int64)]
 
 
+def slot_sizes(nest: Loop, owned, trip: int, chunk_size: int):
+    """``(slot, valid)``: exact accesses at every (thread, round,
+    chunk-slot) of an ``owned`` chunk matrix (invalid slots 0), for any
+    supported nest shape — the single home of the per-slot size rule
+    shared by the engine's clock tables and sampling's window counts."""
+    import numpy as np
+
+    g = owned[:, :, None].astype(np.int64) * chunk_size \
+        + np.arange(chunk_size)
+    valid = (owned[:, :, None] >= 0) & (g < trip)
+    if nest_is_quad(nest):
+        sizes = nest_iteration_sizes(nest, np.clip(g, 0, trip - 1))
+        slot = np.where(valid, sizes, 0)
+    else:
+        n0, n1 = nest_iteration_size_affine(nest)
+        slot = np.where(valid, n0 + n1 * g, 0)
+    return slot, valid
+
+
 @functools.lru_cache(maxsize=128)
 def _nest_sizes_full(nest: Loop) -> "np.ndarray":
     import numpy as np
