@@ -40,8 +40,8 @@ def _build(name: str, sampler: str, extra: list[str],
            cppflags: list[str] | None = None) -> Path:
     """Compile one reference binary into tests/gsl_shim/build (cached).
 
-    ``cppflags`` overrides the default config flags — the second-config
-    parity test rebuilds at -DTHREAD_NUM=2/-DCHUNK_SIZE=8."""
+    ``cppflags`` overrides the default config flags — the alternate-config
+    parity test rebuilds at several -DTHREAD_NUM/-DCHUNK_SIZE pairs."""
     cmd = ["g++", *(CPPFLAGS if cppflags is None else cppflags), *extra,
            str(REF / "sampler" / sampler), *RUNTIME,
            "-lm", "-lpthread"]
@@ -180,12 +180,15 @@ def test_reference_dispatcher_static_start_chunk_per_tid_rounding():
     assert checked > 100
 
 
-@pytest.mark.parametrize("threads,chunk", [(2, 8)])
-def test_reference_second_config_matches(threads, chunk):
+@pytest.mark.parametrize("threads,chunk", [(2, 8), (8, 2), (3, 5)])
+def test_reference_alternate_configs_match(threads, chunk):
     """VERDICT r3 missing #2: config-generality against the one independent
-    oracle.  Rebuild the reference's seq sampler at a SECOND compile-time
-    config (-DTHREAD_NUM/-DCHUNK_SIZE, c_lib/test/Makefile:13) and byte-diff
-    its acc output against ``cli acc --threads 2 --chunk 8``."""
+    oracle.  Rebuild the reference's seq sampler at other compile-time
+    configs (-DTHREAD_NUM/-DCHUNK_SIZE, c_lib/test/Makefile:13) and
+    byte-diff acc output against ``cli acc --threads T --chunk C``.  The
+    thread count T enters the CRI math itself (NBD p = 1/T, the racetrack
+    exponent, the 4000*(T-1)/T cutoff), so each extra T is an independent
+    check of the statistics pipeline, not just the schedule."""
     import contextlib
     import io as _io
 
