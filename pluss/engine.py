@@ -60,7 +60,6 @@ from pluss.spec import (
     nest_has_varying_start,
     nest_is_quad,
     nest_iteration_size,
-    nest_iteration_size_affine,
     slot_sizes,
 )
 
@@ -647,9 +646,6 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     acc = np.zeros((len(spec.nests), T), np.int64)  # true accesses per thread
     for ni, (sched, refs, body, asg, owned, W, NW) in enumerate(geom):
         nest_q = nest_is_quad(spec.nests[ni])
-        n0 = n1 = 0
-        if not nest_q:
-            n0, n1 = nest_iteration_size_affine(spec.nests[ni])
         tri = nest_has_bounds(spec.nests[ni])
         tpl = clean = None
         var_refs = refs

@@ -319,10 +319,7 @@ def nest_iteration_size(nest: Loop) -> int:
     (for bounded nests: the size evaluated at its worst parallel index —
     used for static shapes and window sizing)."""
     if nest_is_quad(nest):
-        import numpy as np
-
-        return int(nest_iteration_sizes(
-            nest, np.arange(nest.trip, dtype=np.int64)).max())
+        return int(_nest_sizes_full(nest).max())
     n0, n1 = nest_iteration_size_affine(nest)
     if n1 == 0:
         return n0
@@ -396,13 +393,11 @@ def _nest_sizes_full(nest: Loop) -> "np.ndarray":
 
 def _any_child_bounded_on(loop: Loop, level: int) -> bool:
     """True when any loop in ``loop``'s body tree is bounded on ``level``."""
-    def walk(item) -> bool:
-        if isinstance(item, Ref):
-            return False
-        return (item.bound_coef is not None and item.bound_level == level) \
-            or any(walk(b) for b in item.body)
-
-    return any(walk(b) for b in loop.body)
+    return any(
+        _nest_any(b, lambda l: l.bound_coef is not None
+                  and l.bound_level == level)
+        for b in loop.body if isinstance(b, Loop)
+    )
 
 
 def _nest_any(nest: Loop, pred) -> bool:
@@ -465,7 +460,7 @@ def _fadd(a: dict, b: dict) -> dict:
 
 
 def _fscale(f: dict, c: int) -> dict:
-    return {k: v * c for k, v in f.items() if v * c}
+    return {k: cv for k, v in f.items() if (cv := v * c)}
 
 
 def _fsum_over(f: dict, tdesc) -> dict:
