@@ -1423,7 +1423,13 @@ def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             acc = ys if acc is None else [
                 np.concatenate([a, b], axis=1) for a, b in zip(acc, ys)]
         share_ys.append(tuple(acc))
-    return _finalize(pl, hist_np, share_ys, share_cap, cfg)
+    try:
+        return _finalize(pl, hist_np, share_ys, share_cap, cfg)
+    except ShareCapExceeded as e:
+        new_cap = _auto_share_cap(e, share_cap)
+        return run_sliced(spec, cfg, new_cap, assignment, start_point,
+                          window_accesses, thread_batch,
+                          max_dispatch_entries)
 
 
 def _unpack_slice(flat: np.ndarray, L: int, cap: int, triples: int,
@@ -1563,6 +1569,27 @@ def add_static_share(share_raw: list[dict],
                 d[v] = d.get(v, 0) + c
 
 
+class ShareCapExceeded(ValueError):
+    """A device-side window extracted more unique share values than the
+    ``share_cap`` slots could hold (the surplus was dropped on device, so
+    the run must be REPEATED at a larger cap — the data cannot be
+    recovered host-side).  ``needed`` is the observed per-window maximum;
+    :func:`run`/:func:`run_sliced` catch this and retry automatically."""
+
+    def __init__(self, needed: int, cap: int):
+        super().__init__(
+            f"share-value capacity exceeded: {needed} uniques > cap "
+            f"{cap}; re-run with a larger share_cap"
+        )
+        self.needed = needed
+
+
+#: auto-retry never raises the cap beyond this (a runaway cap would ask the
+#: device for a [T, NW, cap] x2 f64 buffer; 2^17 keeps it under ~1 GiB at
+#: bench window counts while covering every known workload by 38x)
+MAX_AUTO_SHARE_CAP = 1 << 17
+
+
 def merge_share_windows(svals, scnts, snu, share_cap: int,
                         thread_num: int, sign: int = 1,
                         out: list[dict] | None = None) -> list[dict]:
@@ -1582,15 +1609,16 @@ def merge_share_windows(svals, scnts, snu, share_cap: int,
     """
     if out is None:
         out = [dict() for _ in range(thread_num)]
+    # overflow scan over ALL nests first: raising with the GLOBAL max lets
+    # the auto-retry converge in one re-run even when a later nest needs a
+    # larger cap than the first overflowing one
+    needed = max((int(np.asarray(nu).max(initial=0)) for nu in snu),
+                 default=0)
+    if needed > share_cap:
+        raise ShareCapExceeded(needed, share_cap)
     for ni in range(len(svals)):
         sv = np.asarray(svals[ni])
         sc = np.asarray(scnts[ni])
-        nu = np.asarray(snu[ni])
-        if (nu > share_cap).any():
-            raise ValueError(
-                f"share-value capacity exceeded: {int(nu.max())} uniques > cap "
-                f"{share_cap}; re-run with a larger share_cap"
-            )
         for t in range(thread_num):
             vals, cnts = sv[t].reshape(-1, sv.shape[-1]), sc[t].reshape(-1, sc.shape[-1])
             nz = cnts > 0
@@ -1737,7 +1765,26 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                      _normalize_thread_batch(thread_batch, cfg))
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
     hist, share_ys = _unpack(np.asarray(f(tids)), pl, share_cap)
-    return _finalize(pl, hist, share_ys, share_cap, cfg)
+    try:
+        return _finalize(pl, hist, share_ys, share_cap, cfg)
+    except ShareCapExceeded as e:
+        new_cap = _auto_share_cap(e, share_cap)
+        return run(spec, cfg, new_cap, assignment, start_point,
+                   window_accesses, backend, thread_batch)
+
+
+def _auto_share_cap(e: ShareCapExceeded, share_cap: int) -> int:
+    """Next cap for the automatic overflow retry (power of two covering the
+    observed per-window unique count), or re-raise past the ceiling."""
+    import sys
+
+    new_cap = max(share_cap * 2, 1 << (e.needed - 1).bit_length())
+    if new_cap > MAX_AUTO_SHARE_CAP:
+        raise e
+    print(f"engine: share cap {share_cap} overflowed ({e.needed} uniques "
+          f"in one window); re-running with share_cap={new_cap}",
+          file=sys.stderr)
+    return new_cap
 
 
 def _finalize(pl: StreamPlan, hist: np.ndarray, share_ys,

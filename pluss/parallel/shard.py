@@ -39,6 +39,7 @@ from pluss.engine import (
     StreamPlan,
     _array_ranges,
     _sort_window,
+    ShareCapExceeded,
     merge_share_windows,
     natural_n_windows,
     plan,
@@ -350,10 +351,18 @@ def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     # every non-thread axis anyway, so one swap covers all nests/sub-windows
     sv, sc, snu = np.asarray(sv), np.asarray(sc), np.asarray(snu)
     T = cfg.thread_num
-    share_raw = merge_share_windows(
-        [np.moveaxis(sv, 1, 0)], [np.moveaxis(sc, 1, 0)],
-        [np.moveaxis(snu, 1, 0)], share_cap, T,
-    )
+    try:
+        share_raw = merge_share_windows(
+            [np.moveaxis(sv, 1, 0)], [np.moveaxis(sc, 1, 0)],
+            [np.moveaxis(snu, 1, 0)], share_cap, T,
+        )
+    except ShareCapExceeded as e:
+        # device windows dropped surplus uniques: same graceful auto-retry
+        # contract as engine.run / run_sliced
+        from pluss.engine import _auto_share_cap
+
+        return shard_run(spec, cfg, _auto_share_cap(e, share_cap), mesh,
+                         assignment, start_point, window_accesses)
     hv = np.asarray(head_share)
     for dev in range(hv.shape[0]):
         for t in range(T):

@@ -313,3 +313,29 @@ def test_thread_batch_matches_vmap():
     np.testing.assert_array_equal(a.noshare_dense, b.noshare_dense)
     np.testing.assert_array_equal(a.noshare_dense, c.noshare_dense)
     assert a.share_raw == b.share_raw == c.share_raw
+
+
+def test_share_cap_auto_retry_matches_oracle():
+    """A device window with more unique share values than share_cap slots
+    drops the surplus on device; run() must detect the overflow at merge
+    time and transparently re-run at a covering power-of-two cap (the
+    graceful-degradation contract — no supported workload may die on
+    default knobs)."""
+    from pluss.models import conv2d
+
+    spec = conv2d(16)
+    cfg = SamplerConfig(cls=8)
+    want = run(spec, cfg)  # default cap: no overflow
+    got = run(spec, cfg, share_cap=1)  # forces the auto-retry path
+    assert got.max_iteration_count == want.max_iteration_count
+    assert got.noshare_list() == want.noshare_list()
+    assert got.share_list() == want.share_list()
+
+
+def test_share_cap_ceiling_still_raises(monkeypatch):
+    from pluss import engine as eng
+    from pluss.models import conv2d
+
+    monkeypatch.setattr(eng, "MAX_AUTO_SHARE_CAP", 2)
+    with pytest.raises(ValueError, match="capacity exceeded"):
+        run(conv2d(16), SamplerConfig(cls=8), share_cap=1)

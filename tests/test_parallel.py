@@ -183,3 +183,20 @@ def test_shard_var_refs_in_template_window():
     assert n.tpl is not None and n.var_refs, "precondition: split groups"
     assert n.ultra_windows().any(), "precondition: template branch taken"
     assert_same(shard_run(spec, cfg, mesh=default_mesh(4)), run(spec, cfg))
+
+
+def test_shard_share_cap_auto_retry_matches_engine():
+    """The graceful share-cap auto-retry contract covers the sharded
+    backend too (engine.run / run_sliced / shard_run all re-run at a
+    covering cap instead of dying on default knobs)."""
+    from pluss.engine import run
+    from pluss.models import REGISTRY
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    spec = REGISTRY["conv2d"](16)
+    cfg = SamplerConfig(cls=8)
+    want = run(spec, cfg)
+    got = shard_run(spec, cfg, share_cap=1, mesh=default_mesh(4))
+    assert got.max_iteration_count == want.max_iteration_count
+    assert (got.noshare_dense == want.noshare_dense).all()
+    assert got.share_list() == want.share_list()
