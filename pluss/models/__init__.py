@@ -11,7 +11,8 @@ from pluss.models.linalg import (atax, bicg, doitgen, gemver, gesummv,
 from pluss.models.polybench import (correlation, covariance, mm2, mm3,
                                     symm, syr2k, syrk, syrk_triangular, trmm)
 from pluss.models.solvers import (cholesky, durbin, floyd_warshall,
-                                  gramschmidt, lu, trisolv)
+                                  gramschmidt, lu, ludcmp, seidel2d,
+                                  trisolv)
 from pluss.models.stencils import conv2d, fdtd2d, heat3d, stencil3d
 
 REGISTRY = {
@@ -42,6 +43,8 @@ REGISTRY = {
     "floyd_warshall": floyd_warshall,
     "cholesky": cholesky,
     "lu": lu,
+    "ludcmp": ludcmp,
+    "seidel2d": seidel2d,
 }
 
 __all__ = [
@@ -49,6 +52,6 @@ __all__ = [
     "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d",
     "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm",
     "covariance", "correlation", "trisolv", "durbin", "gramschmidt",
-    "floyd_warshall", "cholesky", "lu",
+    "floyd_warshall", "cholesky", "lu", "ludcmp", "seidel2d",
     "REGISTRY",
 ]

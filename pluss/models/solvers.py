@@ -250,6 +250,88 @@ def lu(n: int = 128) -> LoopNestSpec:
     )
 
 
+def ludcmp(n: int = 128) -> LoopNestSpec:
+    """ludcmp, PolyBench 4.2: LU factor + forward/back substitution.
+
+    Three nests in one spec — the integration stress case (per-thread LAT
+    tables and clocks persist across nests, as across the reference's
+    sequential nests):
+
+    1. the LU nest (identical structure to :func:`lu` — quad contract);
+    2. forward substitution ``L y = b``: per i, load ``b[i]``; the
+       ``j < i`` loop loads ``A[i][j]``, ``y[j]`` (cross-thread) and
+       re-walks the running sum in a register; store ``y[i]``;
+    3. back substitution ``U x = y`` with a DESCENDING parallel loop
+       (``i = n-1 .. 0``: start n-1, step -1): load ``y[i]``; the
+       ``j in [i+1, n)`` loop loads ``A[i][j]`` and ``x[j]``
+       (cross-thread); then ``A[i][i]`` and the ``x[i]`` store.  With the
+       parallel INDEX k (i = n-1-k), the j loop is start=n, start_coef=-1,
+       trip = a + b*k with (a, b) = (0, 1).
+    """
+    span = share_span_formula(n)
+    # nest 1 IS lu's nest (frozen dataclasses — safely shared); any fix to
+    # the LU spec lands in both models by construction
+    lu_nest = lu(n).nests[0]
+
+    fwd_j = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
+        Ref("F0", "A", addr_terms=((0, n), (1, 1))),
+        Ref("F1", "y", addr_terms=((1, 1),), share_span=span),
+    ))
+    fwd = Loop(trip=n, body=(
+        Ref("B0", "b", addr_terms=((0, 1),)),
+        fwd_j,
+        Ref("Y0", "y", addr_terms=((0, 1),)),
+    ))
+
+    back_j = Loop(trip=max(n - 1, 1), start=n, start_coef=-1,
+                  bound_coef=(0, 1), body=(
+        Ref("U0", "A", addr_terms=((0, n), (1, 1))),
+        Ref("X0", "x", addr_terms=((1, 1),), share_span=span),
+    ))
+    back = Loop(trip=n, start=n - 1, step=-1, body=(
+        Ref("Y1", "y", addr_terms=((0, 1),)),
+        back_j,
+        Ref("U1", "A", addr_terms=((0, n + 1),)),
+        Ref("X1", "x", addr_terms=((0, 1),)),
+    ))
+    return LoopNestSpec(
+        name=f"ludcmp{n}",
+        arrays=(("A", n * n), ("b", n), ("y", n), ("x", n)),
+        nests=(lu_nest, fwd, back),
+    )
+
+
+def seidel2d(n: int = 64, tsteps: int = 8) -> LoopNestSpec:
+    """seidel2d, PolyBench 4.2: in-place 9-point Gauss-Seidel sweeps.
+
+    The parallel loop is the OUTER time loop (the ppcg pragma convention,
+    ``/root/reference/c_lib/test/gemm.ppcg_omp.c:90``): every simulated
+    thread revisits the identical address set each time step, so ALL nine
+    loads and the store are parallel-invariant (floyd_warshall has one
+    such pattern among three; here it is the whole nest) and all carry
+    the share span.
+    """
+    m = n - 2
+    span = share_span_formula(m)
+    off = lambda di, dj: (di + 1) * n + (dj + 1)
+    body = []
+    for nm, (di, dj) in (("mm", (-1, -1)), ("mc", (-1, 0)), ("mp", (-1, 1)),
+                         ("cm", (0, -1)), ("cc", (0, 0)), ("cp", (0, 1)),
+                         ("pm", (1, -1)), ("pc", (1, 0)), ("pp", (1, 1))):
+        body.append(Ref(f"A{nm}", "A", addr_terms=((1, n), (2, 1)),
+                        addr_base=off(di, dj), share_span=span))
+    body.append(Ref("Ao", "A", addr_terms=((1, n), (2, 1)),
+                    addr_base=off(0, 0), share_span=span))
+    nest = Loop(trip=tsteps, body=(
+        Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),)),
+    ))
+    return LoopNestSpec(
+        name=f"seidel2d{n}x{tsteps}",
+        arrays=(("A", n * n),),
+        nests=(nest,),
+    )
+
+
 def floyd_warshall(n: int = 128) -> LoopNestSpec:
     """floyd_warshall: all-pairs shortest paths; parallel over ``k``.
 

@@ -283,3 +283,33 @@ def test_durbin_start_point_resume_matches_oracle():
     assert_matches_oracle(spec, cfg,
                           engine.run(spec, cfg, start_point=5),
                           start_point=5)
+
+
+@pytest.mark.parametrize("name,n", [("ludcmp", 10), ("ludcmp", 13),
+                                    ("seidel2d", 8)])
+def test_composite_families_match_oracle(name, n):
+    """ludcmp: the integration stress case — a quad LU nest, a forward-
+    substitution nest and a DESCENDING back-substitution nest share one
+    LAT/clock state; seidel2d: a fully parallel-invariant time loop."""
+    from pluss.models import REGISTRY
+
+    spec = REGISTRY[name](n)
+    for cfg in (SamplerConfig(cls=8),
+                SamplerConfig(thread_num=3, chunk_size=5, cls=16)):
+        assert_matches_oracle(spec, cfg, engine.run(spec, cfg))
+
+
+@pytest.mark.parametrize("name,n", [("ludcmp", 10), ("seidel2d", 8)])
+def test_composite_windowed_and_shard_match(name, n):
+    from pluss.models import REGISTRY
+    from pluss.parallel.shard import default_mesh, shard_run
+
+    spec = REGISTRY[name](n)
+    cfg = SamplerConfig(cls=8)
+    assert_matches_oracle(spec, cfg,
+                          engine.run(spec, cfg, window_accesses=1))
+    want = engine.run(spec, cfg)
+    got = shard_run(spec, cfg, mesh=default_mesh(4))
+    assert got.max_iteration_count == want.max_iteration_count
+    assert (got.noshare_dense == want.noshare_dense).all()
+    assert got.share_list() == want.share_list()
