@@ -180,7 +180,11 @@ def timed_reps(step, reps: int, label: str):
 
 
 def emit(metric: str, refs: int, best_s: float, base_s: float | None,
-         **extra) -> None:
+         path: str = "", **extra) -> None:
+    """One JSON metric line.  ``path`` names the code path measured
+    (engine.describe_path label, or a trace-pipeline name) so the record
+    is self-describing — "sortpath" metric names notwithstanding
+    (VERDICT r5 task 4; names stay stable for round-over-round diffs)."""
     vs = base_s / best_s if base_s else None
     refs_per_sec = refs / best_s
     log(f"bench: {metric} best {refs_per_sec:.3e} refs/s"
@@ -190,6 +194,7 @@ def emit(metric: str, refs: int, best_s: float, base_s: float | None,
         "value": round(refs_per_sec, 1),
         "unit": "refs/s",
         "vs_baseline": round(vs, 3) if vs is not None else None,
+        "path": path,
         **extra,
     }), flush=True)
 
@@ -270,7 +275,8 @@ def bench_trace_device(n_lines: int = 4_200_000) -> None:
                         pdt.type(2**31 - 4))
     np.asarray(hist[:1])
     dt = time.perf_counter() - t0
-    emit("trace_device_scan_refs_per_sec", reps * batch, dt, None)
+    emit("trace_device_scan_refs_per_sec", reps * batch, dt, None,
+         path="trace_device_scan")
 
 
 def ensure_trace(n_refs: int) -> str:
@@ -366,6 +372,7 @@ def bench_trace_resident(n_refs: int) -> None:
     assert int(rep.hist.sum()) == n_run  # BEFORE emit: a corrupt replay
     # must never leave a metric line in the round record
     emit(f"trace{n_refs}_resident_refs_per_sec", n_run, replay_s, base_s,
+         path="trace_resident",
          refs_replayed=n_run, refs_requested=n_refs,
          shrunk=bool(n_run != n_refs),
          upload_s=round(stats["upload_s"], 1),
@@ -429,6 +436,7 @@ def bench_trace(n_refs: int) -> None:
     # stays keyed on one string; refs_requested + shrunk let downstream
     # tooling filter budget-shrunk runs without parsing stderr
     emit(f"trace{n_refs}_replay_refs_per_sec", n_run, best_s, base_s,
+         path="trace_stream",
          refs_replayed=n_run, refs_requested=n_refs,
          shrunk=bool(n_run != n_refs))
 
@@ -472,7 +480,8 @@ def main() -> int:
         best_s, res = timed_reps(step_of(gemm(128)), REPS, "gemm128")
         emit("gemm128_sampler_refs_per_sec_cpu_fallback",
              res.max_iteration_count, best_s,
-             cached_native_s("gemm128", lambda: native_baseline_s(128)))
+             cached_native_s("gemm128", lambda: native_baseline_s(128)),
+             path=engine.describe_path(gemm(128)))
         return 0
 
     # headline FIRST (round 3's record has rc=124 with this metric still
@@ -484,10 +493,16 @@ def main() -> int:
     flagship = None
     try:
         best_s, res = timed_reps(step_of(gemm(1024)), REPS, "gemm1024")
+        try:  # label-only: must never sink an already-measured flagship
+            flag_path = engine.describe_path(gemm(1024))
+        except Exception as e:
+            log(f"bench: describe_path(gemm1024) failed: {e}")
+            flag_path = ""
         flagship = ("gemm1024_sampler_refs_per_sec",
                     res.max_iteration_count, best_s,
                     cached_native_s("gemm1024",
-                                    lambda: native_baseline_s(1024)))
+                                    lambda: native_baseline_s(1024)),
+                    flag_path)
         emit(*flagship)
     except Exception as e:
         log(f"bench: FLAGSHIP gemm1024 metric failed: {e}")
@@ -506,7 +521,8 @@ def main() -> int:
                                      f"syrk{n_syrk}")
             emit(f"syrk{n_syrk}_sortpath_refs_per_sec",
                  res.max_iteration_count, best_s,
-                 native_s_of("syrk1024", syrk(n_syrk)))
+                 native_s_of("syrk1024", syrk(n_syrk)),
+                 path=engine.describe_path(syrk(n_syrk)))
         except Exception as e:  # never let an aux metric sink the record
             log(f"bench: syrk metric failed: {e}")
 
@@ -523,7 +539,8 @@ def main() -> int:
             best_s, res = timed_reps(step_of(spec_tri), 1, "syrktri1024")
             emit("syrktri1024_sortpath_refs_per_sec",
                  res.max_iteration_count, best_s,
-                 native_s_of("syrktri1024", spec_tri))
+                 native_s_of("syrktri1024", spec_tri),
+                 path=engine.describe_path(spec_tri))
         except Exception as e:
             log(f"bench: triangular metric failed: {e}")
 
@@ -569,8 +586,11 @@ def main() -> int:
                 log(f"bench: gemm128 MRC L2 error vs native C++: {err:.2e}")
                 print(json.dumps({
                     "metric": "gemm128_mrc_l2_error_vs_native",
-                    "value": round(err, 9), "unit": "relative_l2",
+                    # UNROUNDED: round(err, 9) erased the 1.39e-14 in the
+                    # r5 record (ADVICE r5, BENCH_r05.json value 0.0)
+                    "value": err, "unit": "relative_l2",
                     "vs_baseline": None,
+                    "path": engine.describe_path(gemm(128)) + "+cri+aet",
                 }), flush=True)
         except Exception as e:
             log(f"bench: mrc l2 metric failed: {e}")

@@ -1,0 +1,57 @@
+"""Static analysis over the Loop/Ref/LoopNestSpec IR.
+
+Four passes feed one structured-diagnostics stream
+(:mod:`pluss.analysis.diagnostics` — stable PLxxx codes):
+
+1. contract  (PL4xx) — the flatten-time structural restrictions, with
+   tree paths (:mod:`pluss.analysis.contract`);
+2. bounds    (PL1xx) — exact address-range proofs against the declared
+   array sizes (:mod:`pluss.analysis.bounds`);
+3. race/deps (PL3xx) — GCD + Banerjee-style dependence tests proving or
+   refuting cross-thread conflicts on the parallel dimension, plus
+   per-reference carried-level classification
+   (:mod:`pluss.analysis.deps`);
+4. share     (PL2xx) — ``share_span`` recomputation from the carrying
+   loop and consistency with the race classification
+   (:mod:`pluss.analysis.sharespan`).
+
+Everything here is host-side Python/numpy over the declarative spec —
+no JAX, no device, no stream enumeration — so ``pluss lint`` runs before
+(and without) any XLA compilation.
+
+Entry points: :func:`lint_spec` for one spec, ``pluss lint`` (see
+:mod:`pluss.cli`) for the CLI surface, and ``--verify`` on the engine
+modes for the opt-in pre-pass.
+"""
+
+from __future__ import annotations
+
+from pluss.analysis import bounds, contract, deps, sharespan
+from pluss.analysis.diagnostics import (CODES, Diagnostic, Severity,
+                                        error_count, format_json,
+                                        format_text, sort_key, with_model)
+from pluss.spec import LoopNestSpec
+
+
+def lint_spec(spec: LoopNestSpec) -> list[Diagnostic]:
+    """Run all four passes over one spec; diagnostics sorted errors-first.
+
+    Contract errors gate the semantic passes per nest: a nest the flatten
+    rejects has no well-defined iteration domain, so bounds/race/share
+    skip it instead of reasoning from garbage.
+    """
+    diags = contract.check(spec)
+    bad = frozenset(d.nest for d in diags
+                    if d.severity is Severity.ERROR and d.nest is not None)
+    diags += bounds.check(spec, skip_nests=bad)
+    ana = deps.analyze(spec, skip_nests=bad)  # profiled once, shared below
+    diags += deps.check(spec, skip_nests=bad, analysis=ana)
+    diags += sharespan.check(spec, ana.classes)
+    return sorted(diags, key=sort_key)
+
+
+__all__ = [
+    "CODES", "Diagnostic", "Severity", "lint_spec", "error_count",
+    "format_text", "format_json", "with_model",
+    "bounds", "contract", "deps", "sharespan",
+]

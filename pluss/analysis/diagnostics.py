@@ -1,0 +1,142 @@
+"""Structured diagnostics for the LoopNestSpec static analyzer.
+
+Every finding of :mod:`pluss.analysis` is a :class:`Diagnostic` with a
+STABLE code — the code, not the message text, is the machine-readable
+contract (tests and tooling key on it; wording may improve freely).
+
+Code families mirror the analyzer's four passes:
+
+- ``PL1xx`` bounds   (:mod:`pluss.analysis.bounds`): address-range proofs
+  against the declared array sizes.
+- ``PL2xx`` share    (:mod:`pluss.analysis.sharespan`): ``share_span``
+  consistency against the recomputed carrying-loop formula and the race
+  detector's cross-thread classification.
+- ``PL3xx`` race     (:mod:`pluss.analysis.deps`): affine dependence tests
+  (GCD + Banerjee-style bounds) on the parallel dimension.
+- ``PL4xx`` contract (:mod:`pluss.analysis.contract`): the structural
+  restrictions ``spec.flatten_nest`` / ``flatten_nest_quad`` enforce,
+  surfaced as records with tree paths instead of bare ``ValueError``.
+
+Severity semantics: ERROR means the spec is wrong (out-of-bounds access,
+undeclared array, contract violation) — ``pluss lint`` exits nonzero.
+WARNING flags suspicious-but-runnable facts (hand-copied span mismatch,
+cross-thread conflicts the ``#pragma pluss parallel`` contract merely
+asserts away).  INFO records classifications (carried levels) for tooling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # "error", not "Severity.ERROR", in reports
+        return self.name.lower()
+
+
+#: code -> (pass family, one-line meaning).  The single source of truth for
+#: the README's diagnostic-code table (tests assert the two agree).
+CODES: dict[str, tuple[str, str]] = {
+    "PL101": ("bounds", "reference address range escapes its array"),
+    "PL102": ("bounds", "reference targets an undeclared array"),
+    "PL103": ("bounds", "declared array is never referenced"),
+    "PL104": ("bounds", "duplicate array declaration"),
+    "PL105": ("bounds", "array declared with a non-positive size"),
+    "PL201": ("share", "share_span is not a meaningful threshold"),
+    "PL202": ("share", "share_span differs from the recomputed "
+                       "carrying-loop formula"),
+    "PL203": ("share", "reference can observe cross-thread reuse but "
+                       "carries no share_span"),
+    "PL204": ("share", "share_span on a reference with no cross-thread "
+                       "reuse"),
+    "PL301": ("race", "cross-thread write-write conflict on the parallel "
+                      "dimension"),
+    "PL302": ("race", "cross-thread read-write conflict on the parallel "
+                      "dimension"),
+    "PL303": ("race", "reuse carried-level classification"),
+    "PL401": ("contract", "the parallel (outermost) loop must be "
+                          "rectangular"),
+    "PL402": ("contract", "inner bound leaves the declared [0, trip] "
+                          "range"),
+    "PL403": ("contract", "addr term depth exceeds the loop chain depth"),
+    "PL404": ("contract", "bound_level must name an enclosing loop"),
+    "PL405": ("contract", "outside the quadratic position contract"),
+    "PL406": ("contract", "duplicate reference name inside one nest"),
+    "PL407": ("contract", "spec rejected by flatten"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One analyzer finding, addressable into the Loop/Ref tree.
+
+    ``path`` spells the tree position in attribute syntax
+    (``nests[0].body[1].body[2]``); ``ref``/``array``/``nest`` carry the
+    same identity as plain fields for JSON consumers.
+    """
+
+    code: str
+    severity: Severity
+    message: str
+    path: str = ""
+    nest: int | None = None
+    ref: str | None = None
+    array: str | None = None
+    model: str | None = None
+
+    def format(self) -> str:
+        where = self.path or (f"nests[{self.nest}]" if self.nest is not None
+                              else "")
+        bits = [b for b in (
+            f"{self.model}:" if self.model else None,
+            where or None,
+            f"[{self.code} {self.severity}]",
+            self.message,
+        ) if b]
+        return " ".join(bits)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["severity"] = str(self.severity)
+        return {k: v for k, v in d.items() if v is not None and v != ""}
+
+
+def error_count(diags: list[Diagnostic]) -> int:
+    return sum(1 for d in diags if d.severity is Severity.ERROR)
+
+
+def with_model(diags: list[Diagnostic], model: str) -> list[Diagnostic]:
+    """Stamp a model name onto diagnostics (batch-lint labeling)."""
+    return [dataclasses.replace(d, model=model) for d in diags]
+
+
+def format_text(diags: list[Diagnostic], min_severity: Severity =
+                Severity.WARNING) -> str:
+    """Human report: one line per diagnostic at or above ``min_severity``
+    (INFO-level classifications stay JSON-only by default)."""
+    return "\n".join(d.format() for d in diags
+                     if d.severity >= min_severity)
+
+
+def format_json(diags: list[Diagnostic]) -> str:
+    return json.dumps(
+        {
+            "diagnostics": [d.to_dict() for d in diags],
+            "errors": error_count(diags),
+            "warnings": sum(1 for d in diags
+                            if d.severity is Severity.WARNING),
+        },
+        indent=1,
+    )
+
+
+def sort_key(d: Diagnostic):
+    """Stable report order: errors first, then code, then tree position."""
+    return (-int(d.severity), d.code, d.nest if d.nest is not None else -1,
+            d.path)

@@ -18,7 +18,13 @@ device mesh, :mod:`pluss.parallel.shard`), ``seq`` (one thread at a time).
 Extra subcommands: ``mrc`` exposes the reference's dormant titular capability
 (AET -> miss-ratio curve, pluss_utils.h:758-804) as a live, tested path;
 ``trace`` replays a raw address file through :mod:`pluss.trace` (the
-reference's disabled ``pluss_access`` dynamic path, BASELINE config 5).
+reference's disabled ``pluss_access`` dynamic path, BASELINE config 5);
+``lint`` runs the static spec analyzer (:mod:`pluss.analysis`) over one
+model (or ``--all``) with no device, no JAX tracing and no stream
+enumeration — bounds proofs, race detection, share-span validation and
+contract checks as stable PLxxx diagnostics (``--json`` for tooling).
+``--verify`` opts the engine modes into the same analysis as a pre-pass:
+ERROR-level findings abort before any compilation.
 
 The timed region matches the reference: ``sampler() + pluss_cri_distribute``
 (…omp.cpp:337-339).  Compilation is excluded by a warmup call — the analogue of
@@ -86,13 +92,67 @@ def banner_of(backend: str) -> str:
     return {"vmap": "TPU VMAP", "shard": "TPU SHARD", "seq": "TPU SEQ"}[backend]
 
 
+def _lint_main(args, out) -> int:
+    """``pluss lint <model|--all> [--json]`` — pure host analysis, exits 1
+    when any model has ERROR-level diagnostics."""
+    from pluss import analysis
+
+    if args.all:
+        # each builder's default size — the shapes the benchmarks and the
+        # differential driver actually run
+        targets = [(name, REGISTRY[name]()) for name in sorted(REGISTRY)]
+    else:
+        targets = [(args.model, REGISTRY[args.model](args.n))]
+    all_diags = []
+    errors = 0
+    for name, spec in targets:
+        diags = analysis.with_model(analysis.lint_spec(spec), spec.name)
+        all_diags += diags
+        errors += analysis.error_count(diags)
+    if args.json:
+        out.write(analysis.format_json(all_diags) + "\n")
+    else:
+        text = analysis.format_text(all_diags)
+        if text:
+            out.write(text + "\n")
+        n_warn = sum(1 for d in all_diags
+                     if d.severity is analysis.Severity.WARNING)
+        out.write(f"pluss lint: {len(targets)} model(s), {errors} error(s), "
+                  f"{n_warn} warning(s)\n")
+    return 1 if errors else 0
+
+
+def _verify_spec(spec, out_err) -> int:
+    """The ``--verify`` pre-pass: lint the spec before any compilation.
+    Returns the number of ERROR diagnostics (caller aborts when nonzero);
+    errors and warnings go to stderr so they never pollute the acc/speed
+    block diffs."""
+    from pluss import analysis
+
+    diags = analysis.with_model(analysis.lint_spec(spec), spec.name)
+    text = analysis.format_text(diags)
+    if text:
+        out_err.write(text + "\n")
+    return analysis.error_count(diags)
+
+
 def main(argv: list[str] | None = None) -> int:
     from pluss.utils.platform import enable_x64
 
     enable_x64()
     p = argparse.ArgumentParser(prog="pluss", description=__doc__)
     p.add_argument("mode",
-                   choices=("acc", "speed", "mrc", "trace", "sweep", "sample"))
+                   choices=("acc", "speed", "mrc", "trace", "sweep",
+                            "sample", "lint"))
+    p.add_argument("--all", action="store_true",
+                   help="lint mode: analyze every registered model family "
+                        "(at each builder's default size) instead of "
+                        "--model/--n")
+    p.add_argument("--json", action="store_true",
+                   help="lint mode: machine-readable diagnostics")
+    p.add_argument("--verify", action="store_true",
+                   help="run the static spec analyzer before the engine "
+                        "modes; ERROR diagnostics abort the run")
     p.add_argument("--rates", default="0.05,0.1,0.25,0.5,1.0",
                    help="sample-mode sampling rates (comma list)")
     p.add_argument("--sample-mode", default="uniform",
@@ -134,6 +194,11 @@ def main(argv: list[str] | None = None) -> int:
                         "DIR (view with tensorboard or xprof)")
     args = p.parse_args(argv)
 
+    if args.mode == "lint":
+        # pure host analysis: no accelerator probe, no platform setup —
+        # a broken spec must be reportable from any box, instantly
+        return _lint_main(args, sys.stdout)
+
     if args.cpu:
         from pluss.utils.platform import force_cpu
 
@@ -152,6 +217,12 @@ def main(argv: list[str] | None = None) -> int:
             force_cpu(8)
 
     spec = REGISTRY[args.model](args.n)
+    if args.verify:
+        n_err = _verify_spec(spec, sys.stderr)
+        if n_err:
+            print(f"pluss: --verify found {n_err} error(s) in "
+                  f"{spec.name}; refusing to run", file=sys.stderr)
+            return 2
     cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk)
     backends_explicit = args.backends is not None
     backends = [b.strip()

@@ -587,6 +587,46 @@ def natural_n_windows(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     return max(nw for *_, nw in geom)
 
 
+def plan_path(pl: StreamPlan) -> str:
+    """Short label of the execution paths a plan's windows take, for
+    self-describing bench/driver records (VERDICT r5 task 4): any of
+    ``template`` (hoisted static-window analysis), ``overlay``
+    (interleave overlays), ``closed_form`` (row-private/sweep-group
+    histogram tables), ``sort`` (device sort windows), joined with ``+``
+    when one run mixes them."""
+    parts: list[str] = []
+
+    def add(p: str) -> None:
+        if p not in parts:
+            parts.append(p)
+
+    for np_ in pl.nests:
+        if np_.rpg_hist is not None:
+            add("closed_form")
+        if np_.tpl is not None:
+            add("template")
+        if np_.overlays:
+            add("overlay")
+        if np_.refs and (not bool(np_.ultra_windows().all())
+                         or np_.var_refs_novl):
+            add("sort")
+    return "+".join(parts) or "sort"
+
+
+def describe_path(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
+                  window_accesses: int | None = None) -> str:
+    """The :func:`plan_path` label a default :func:`run` of ``spec`` takes,
+    with a ``sliced:`` prefix when the auto-dispatch ladder reroutes it to
+    :func:`run_sliced`.  Uses the shared plan memo, so calling it after a
+    run costs nothing extra."""
+    pl = _plan_cached(spec, cfg, None, None, window_accesses, 1)
+    label = plan_path(pl)
+    if not os.environ.get("PLUSS_NO_AUTO_DISPATCH") \
+            and _auto_dispatch(pl, cfg, None) is not None:
+        label = "sliced:" + label
+    return label
+
+
 def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
          assignment: tuple[tuple[int, ...] | None, ...] | None = None,
          start_point: int | None = None,

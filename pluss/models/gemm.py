@@ -34,19 +34,21 @@ from pluss.spec import Loop, LoopNestSpec, Ref, share_span_formula
 
 def gemm(n: int = 128) -> LoopNestSpec:
     span = share_span_formula(n)
-    c0 = lambda name: Ref(name, "C", addr_terms=((0, n), (1, 1)))
+    # C0/C2 are the loads, C1/C3 the stores of the two C statements
+    c0 = lambda name, w=False: Ref(name, "C", addr_terms=((0, n), (1, 1)),
+                                   is_write=w)
     inner = Loop(
         trip=n,
         body=(
             Ref("A0", "A", addr_terms=((0, n), (2, 1))),
             Ref("B0", "B", addr_terms=((2, n), (1, 1)), share_span=span),
             c0("C2"),
-            c0("C3"),
+            c0("C3", w=True),
         ),
     )
     nest = Loop(
         trip=n,
-        body=(Loop(trip=n, body=(c0("C0"), c0("C1"), inner)),),
+        body=(Loop(trip=n, body=(c0("C0"), c0("C1", w=True), inner)),),
     )
     return LoopNestSpec(
         name=f"gemm{n}",

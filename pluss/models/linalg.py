@@ -21,7 +21,7 @@ from pluss.spec import Loop, LoopNestSpec, Ref, share_span_formula
 def _accum(out: str, terms, tag: str = "") -> tuple[Ref, Ref]:
     """The accumulator's load+store pair (GEMM's C2/C3 pattern)."""
     return (Ref(f"{out}{tag}2", out, addr_terms=terms),
-            Ref(f"{out}{tag}3", out, addr_terms=terms))
+            Ref(f"{out}{tag}3", out, addr_terms=terms, is_write=True))
 
 
 def atax(n: int = 128) -> LoopNestSpec:
@@ -33,7 +33,7 @@ def atax(n: int = 128) -> LoopNestSpec:
     span = share_span_formula(n)
     n1 = Loop(trip=n, body=(
         Ref("T0", "tmp", addr_terms=((0, 1),)),
-        Ref("T1", "tmp", addr_terms=((0, 1),)),
+        Ref("T1", "tmp", addr_terms=((0, 1),), is_write=True),
         Loop(trip=n, body=(
             Ref("A0", "A", addr_terms=((0, n), (1, 1))),
             Ref("X0", "x", addr_terms=((1, 1),), share_span=span),
@@ -45,7 +45,8 @@ def atax(n: int = 128) -> LoopNestSpec:
             Ref("A1", "A", addr_terms=((0, n), (1, 1))),
             Ref("T2", "tmp", addr_terms=((0, 1),)),
             Ref("Y2", "y", addr_terms=((1, 1),), share_span=span),
-            Ref("Y3", "y", addr_terms=((1, 1),), share_span=span),
+            Ref("Y3", "y", addr_terms=((1, 1),), share_span=span,
+                is_write=True),
         )),
     ))
     return LoopNestSpec(
@@ -86,12 +87,13 @@ def bicg(n: int = 128) -> LoopNestSpec:
     span = share_span_formula(n)
     nest = Loop(trip=n, body=(
         Ref("Q0", "q", addr_terms=((0, 1),)),
-        Ref("Q1", "q", addr_terms=((0, 1),)),
+        Ref("Q1", "q", addr_terms=((0, 1),), is_write=True),
         Loop(trip=n, body=(
             Ref("A0", "A", addr_terms=((0, n), (1, 1))),
             Ref("R0", "r", addr_terms=((0, 1),)),
             Ref("S2", "s", addr_terms=((1, 1),), share_span=span),
-            Ref("S3", "s", addr_terms=((1, 1),), share_span=span),
+            Ref("S3", "s", addr_terms=((1, 1),), share_span=span,
+                is_write=True),
             Ref("P0", "p", addr_terms=((1, 1),), share_span=span),
             *_accum("q", ((0, 1),)),
         )),
@@ -108,8 +110,8 @@ def gesummv(n: int = 128) -> LoopNestSpec:
     one shared vector in a single inner loop."""
     span = share_span_formula(n)
     nest = Loop(trip=n, body=(
-        Ref("T0", "tmp", addr_terms=((0, 1),)),
-        Ref("Y0", "y", addr_terms=((0, 1),)),
+        Ref("T0", "tmp", addr_terms=((0, 1),), is_write=True),
+        Ref("Y0", "y", addr_terms=((0, 1),), is_write=True),
         Loop(trip=n, body=(
             Ref("A0", "A", addr_terms=((0, n), (1, 1))),
             Ref("X0", "x", addr_terms=((1, 1),), share_span=span),
@@ -120,7 +122,7 @@ def gesummv(n: int = 128) -> LoopNestSpec:
         )),
         Ref("T4", "tmp", addr_terms=((0, 1),)),
         Ref("Y4", "y", addr_terms=((0, 1),)),
-        Ref("Y5", "y", addr_terms=((0, 1),)),
+        Ref("Y5", "y", addr_terms=((0, 1),), is_write=True),
     ))
     return LoopNestSpec(
         name=f"gesummv{n}",
@@ -137,7 +139,8 @@ def doitgen(n: int = 32) -> LoopNestSpec:
         Loop(trip=n, body=(             # q
             Loop(trip=n, body=(         # p
                 Ref("S0", "sum", addr_terms=((2, 1),)),
-                Ref("S1", "sum", addr_terms=((2, 1),)),
+                Ref("S1", "sum", addr_terms=((2, 1),),
+                    is_write=True),
                 Loop(trip=n, body=(     # s
                     Ref("A0", "A", addr_terms=((0, n * n), (1, n), (3, 1))),
                     Ref("C0", "C4", addr_terms=((3, n), (2, 1)), share_span=span),
@@ -146,7 +149,8 @@ def doitgen(n: int = 32) -> LoopNestSpec:
             )),
             Loop(trip=n, body=(         # p write-back
                 Ref("S4", "sum", addr_terms=((2, 1),)),
-                Ref("A4", "A", addr_terms=((0, n * n), (1, n), (2, 1))),
+                Ref("A4", "A", addr_terms=((0, n * n), (1, n), (2, 1)),
+                    is_write=True),
             )),
         )),
     ))
@@ -176,7 +180,8 @@ def jacobi2d(n: int = 64, tsteps: int = 2) -> LoopNestSpec:
         # the store hits the SAME n-stride array the next sweep reads: write
         # dst[i+1][j+1] at its real interior address, not a compacted layout
         body.append(Ref(f"{dst}o{t}", dst,
-                        addr_terms=((0, n), (1, 1)), addr_base=off(0, 0)))
+                        addr_terms=((0, n), (1, 1)), addr_base=off(0, 0),
+                        is_write=True))
         return Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),))
 
     nests = []
@@ -201,7 +206,8 @@ def gemver(n: int = 128) -> LoopNestSpec:
             Ref("V10", "v1", addr_terms=((1, 1),), share_span=span),
             Ref("U20", "u2", addr_terms=((0, 1),)),
             Ref("V20", "v2", addr_terms=((1, 1),), share_span=span),
-            Ref("A1", "A", addr_terms=((0, n), (1, 1))),
+            Ref("A1", "A", addr_terms=((0, n), (1, 1)),
+                is_write=True),
         )),
     ))
     xaty = Loop(trip=n, body=(
@@ -209,13 +215,13 @@ def gemver(n: int = 128) -> LoopNestSpec:
             Ref("A2", "A", addr_terms=((1, n), (0, 1))),
             Ref("Y0", "y", addr_terms=((1, 1),), share_span=span),
             Ref("X2", "x", addr_terms=((0, 1),)),
-            Ref("X3", "x", addr_terms=((0, 1),)),
+            Ref("X3", "x", addr_terms=((0, 1),), is_write=True),
         )),
     ))
     xz = Loop(trip=n, body=(
         Ref("X4", "x", addr_terms=((0, 1),)),
         Ref("Z0", "z", addr_terms=((0, 1),)),
-        Ref("X5", "x", addr_terms=((0, 1),)),
+        Ref("X5", "x", addr_terms=((0, 1),), is_write=True),
     ))
     wax = Loop(trip=n, body=(
         Loop(trip=n, body=(

@@ -37,15 +37,17 @@ def _matmul_nest(n: int, out: str, a: str, b: str, init_pair: bool) -> Loop:
     False emits a single store (the ``= 0`` pattern of 2mm/3mm's first nests).
     """
     span = share_span_formula(n)
-    o = lambda nm: Ref(nm, out, addr_terms=((0, n), (1, 1)))
-    head = (o(f"{out}0"), o(f"{out}1")) if init_pair else (o(f"{out}0"),)
+    o = lambda nm, w=False: Ref(nm, out, addr_terms=((0, n), (1, 1)),
+                                is_write=w)
+    head = (o(f"{out}0"), o(f"{out}1", w=True)) if init_pair \
+        else (o(f"{out}0", w=True),)
     inner = Loop(
         trip=n,
         body=(
             Ref(f"{a}0", a, addr_terms=((0, n), (2, 1))),
             Ref(f"{b}0", b, addr_terms=((2, n), (1, 1)), share_span=span),
             o(f"{out}2"),
-            o(f"{out}3"),
+            o(f"{out}3", w=True),
         ),
     )
     return Loop(trip=n, body=(Loop(trip=n, body=head + (inner,)),))
@@ -88,17 +90,19 @@ def syrk(n: int = 128) -> LoopNestSpec:
     the structural twin of GEMM's B0.
     """
     span = share_span_formula(n)
-    c = lambda nm: Ref(nm, "C", addr_terms=((0, n), (1, 1)))
+    c = lambda nm, w=False: Ref(nm, "C", addr_terms=((0, n), (1, 1)),
+                                is_write=w)
     inner = Loop(
         trip=n,
         body=(
             Ref("A0", "A", addr_terms=((0, n), (2, 1))),
             Ref("A1", "A", addr_terms=((1, n), (2, 1)), share_span=span),
             c("C2"),
-            c("C3"),
+            c("C3", w=True),
         ),
     )
-    nest = Loop(trip=n, body=(Loop(trip=n, body=(c("C0"), c("C1"), inner)),))
+    nest = Loop(trip=n, body=(Loop(trip=n,
+                                   body=(c("C0"), c("C1", w=True), inner)),))
     return LoopNestSpec(
         name=f"syrk{n}",
         arrays=(("C", n * n), ("A", n * n)),
@@ -117,7 +121,8 @@ def syr2k(n: int = 128) -> LoopNestSpec:
     GEMM's B0 (``/root/reference/src/gemm_sampler.rs:196-201``).
     """
     span = share_span_formula(n)
-    c = lambda nm: Ref(nm, "C", addr_terms=((0, n), (1, 1)))
+    c = lambda nm, w=False: Ref(nm, "C", addr_terms=((0, n), (1, 1)),
+                                is_write=w)
     inner = Loop(
         trip=n,
         body=(
@@ -126,10 +131,11 @@ def syr2k(n: int = 128) -> LoopNestSpec:
             Ref("B0", "B", addr_terms=((0, n), (2, 1))),
             Ref("A1", "A", addr_terms=((1, n), (2, 1)), share_span=span),
             c("C2"),
-            c("C3"),
+            c("C3", w=True),
         ),
     )
-    nest = Loop(trip=n, body=(Loop(trip=n, body=(c("C0"), c("C1"), inner)),))
+    nest = Loop(trip=n, body=(Loop(trip=n,
+                                   body=(c("C0"), c("C1", w=True), inner)),))
     return LoopNestSpec(
         name=f"syr2k{n}",
         arrays=(("C", n * n), ("A", n * n), ("B", n * n)),
@@ -149,14 +155,14 @@ def syrk_triangular(n: int = 128) -> LoopNestSpec:
     span = share_span_formula(n)
     c01 = Loop(trip=n, bound_coef=(1, 1), body=(
         Ref("C0", "C", addr_terms=((0, n), (1, 1))),
-        Ref("C1", "C", addr_terms=((0, n), (1, 1))),
+        Ref("C1", "C", addr_terms=((0, n), (1, 1)), is_write=True),
     ))
     accum = Loop(trip=n, body=(
         Loop(trip=n, bound_coef=(1, 1), body=(
             Ref("A0", "A", addr_terms=((0, n), (1, 1))),
             Ref("A1", "A", addr_terms=((2, n), (1, 1)), share_span=span),
             Ref("C2", "C", addr_terms=((0, n), (2, 1))),
-            Ref("C3", "C", addr_terms=((0, n), (2, 1))),
+            Ref("C3", "C", addr_terms=((0, n), (2, 1)), is_write=True),
         )),
     ))
     return LoopNestSpec(
@@ -188,7 +194,8 @@ def symm(n: int = 128) -> LoopNestSpec:
             # reuses cross simulated threads, so both carry the span
             # (module convention — the structural twins of GEMM's B0)
             Ref("C0", "C", addr_terms=((2, n), (1, 1)), share_span=span),
-            Ref("C1", "C", addr_terms=((2, n), (1, 1)), share_span=span),
+            Ref("C1", "C", addr_terms=((2, n), (1, 1)), share_span=span,
+                is_write=True),
             Ref("B0", "B", addr_terms=((2, n), (1, 1)), share_span=span),
             Ref("A1", "A", addr_terms=((0, n), (2, 1))),
         ),
@@ -197,7 +204,7 @@ def symm(n: int = 128) -> LoopNestSpec:
         Ref("B2", "B", addr_terms=((0, n), (1, 1))),
         Ref("A2", "A", addr_terms=((0, n + 1),)),
         Ref("C2", "C", addr_terms=((0, n), (1, 1))),
-        Ref("C3", "C", addr_terms=((0, n), (1, 1))),
+        Ref("C3", "C", addr_terms=((0, n), (1, 1)), is_write=True),
     )
     nest = Loop(trip=n, body=(Loop(trip=n, body=(kloop,) + tail),))
     return LoopNestSpec(
@@ -223,22 +230,24 @@ def covariance(n: int = 128) -> LoopNestSpec:
     iterator — thread-private.
     """
     span = share_span_formula(n)
-    cov_ij = lambda nm: Ref(nm, "cov", addr_terms=((0, n), (1, 1)))
+    cov_ij = lambda nm, w=False: Ref(nm, "cov", addr_terms=((0, n), (1, 1)),
+                                     is_write=w)
     kloop = Loop(trip=n, body=(
         Ref("D0", "data", addr_terms=((2, n), (0, 1))),
         Ref("D1", "data", addr_terms=((2, n), (1, 1)), share_span=span),
         cov_ij("C1"),
-        cov_ij("C2"),
+        cov_ij("C2", w=True),
     ))
     jloop = Loop(
         trip=n, start_coef=1, bound_coef=(n, -1),
         body=(
-            cov_ij("C0"),
+            cov_ij("C0", w=True),                           # zero store
             kloop,
             cov_ij("C3"),                                   # /= load
-            cov_ij("C4"),                                   # /= store
+            cov_ij("C4", w=True),                           # /= store
             cov_ij("C5"),                                   # symm load
-            Ref("C6", "cov", addr_terms=((1, n), (0, 1))),  # cov[j][i] store
+            Ref("C6", "cov", addr_terms=((1, n), (0, 1)),
+                is_write=True),                             # cov[j][i] store
         ),
     )
     return LoopNestSpec(
@@ -274,44 +283,52 @@ def correlation(n: int = 128) -> LoopNestSpec:
         """``out[j] = 0; for i: out[j] += f(data[i][j], ...)`` plus
         ``tail_pairs`` load+store tail statements on ``out[j]`` — the
         shared shape of the mean and stddev nests."""
-        o = lambda k: Ref(f"{out}{k}", out, addr_terms=((0, 1),))
+        o = lambda k, w=False: Ref(f"{out}{k}", out, addr_terms=((0, 1),),
+                                   is_write=w)
         inner = Loop(trip=n, body=(
             Ref(f"D_{out}", "data", addr_terms=((1, n), (0, 1))),
-            *extra_inner, o("_a"), o("_b"),
+            *extra_inner, o("_a"), o("_b", w=True),
         ))
-        tail = tuple(o(f"_t{i}") for i in range(2 * tail_pairs))
-        return Loop(trip=n, body=(o("_z"), inner) + tail)
+        tail = tuple(o(f"_t{i}", w=bool(i % 2))
+                     for i in range(2 * tail_pairs))
+        return Loop(trip=n, body=(o("_z", w=True), inner) + tail)
 
     n1 = column_reduce("mean", (), tail_pairs=1)
     n2 = column_reduce(
         "stddev", (Ref("M5", "mean", addr_terms=((0, 1),)),), tail_pairs=3)
-    data_ij = lambda nm: Ref(nm, "data", addr_terms=((0, n), (1, 1)))
+    data_ij = lambda nm, w=False: Ref(nm, "data",
+                                      addr_terms=((0, n), (1, 1)),
+                                      is_write=w)
     n3 = Loop(trip=n, body=(
         Loop(trip=n, body=(
             data_ij("D2"),
             Ref("M6", "mean", addr_terms=((1, 1),), share_span=span),
-            data_ij("D3"),
+            data_ij("D3", w=True),
             data_ij("D4"),
             Ref("S5", "stddev", addr_terms=((1, 1),), share_span=span),
-            data_ij("D5n"),
+            data_ij("D5n", w=True),
         )),
     ))
-    corr_ij = lambda nm: Ref(nm, "corr", addr_terms=((0, n), (1, 1)))
+    corr_ij = lambda nm, w=False: Ref(nm, "corr",
+                                      addr_terms=((0, n), (1, 1)),
+                                      is_write=w)
     n4 = Loop(trip=max(n - 1, 1), body=(
-        Ref("C0", "corr", addr_terms=((0, n + 1),)),   # corr[i][i] = 1
+        Ref("C0", "corr", addr_terms=((0, n + 1),),
+            is_write=True),                             # corr[i][i] = 1
         Loop(
             trip=max(n - 1, 1), start=1, start_coef=1,
             bound_coef=(n - 1, -1),
             body=(
-                corr_ij("C1"),                          # corr[i][j] = 0
+                corr_ij("C1", w=True),                  # corr[i][j] = 0
                 Loop(trip=n, body=(
                     Ref("D4", "data", addr_terms=((2, n), (0, 1))),
                     Ref("D5", "data", addr_terms=((2, n), (1, 1)),
                         share_span=span),
-                    corr_ij("C2"), corr_ij("C3"),
+                    corr_ij("C2"), corr_ij("C3", w=True),
                 )),
                 corr_ij("C4"),                          # symm load
-                Ref("C5", "corr", addr_terms=((1, n), (0, 1))),  # store ji
+                Ref("C5", "corr", addr_terms=((1, n), (0, 1)),
+                    is_write=True),                     # store ji
             ),
         ),
     ))
@@ -335,7 +352,8 @@ def trmm(n: int = 128) -> LoopNestSpec:
     GEMM's B0).
     """
     span = share_span_formula(n)
-    b_ij = lambda nm: Ref(nm, "B", addr_terms=((0, n), (1, 1)))
+    b_ij = lambda nm, w=False: Ref(nm, "B", addr_terms=((0, n), (1, 1)),
+                                   is_write=w)
     kloop = Loop(
         trip=max(n - 1, 1), start=1, step=1,
         bound_coef=(n - 1, -1), start_coef=1,
@@ -343,11 +361,11 @@ def trmm(n: int = 128) -> LoopNestSpec:
             Ref("A0", "A", addr_terms=((2, n), (0, 1))),
             Ref("B0", "B", addr_terms=((2, n), (1, 1)), share_span=span),
             b_ij("B1"),
-            b_ij("B2"),
+            b_ij("B2", w=True),
         ),
     )
     nest = Loop(trip=n, body=(
-        Loop(trip=n, body=(kloop, b_ij("B3"), b_ij("B4"))),
+        Loop(trip=n, body=(kloop, b_ij("B3"), b_ij("B4", w=True))),
     ))
     return LoopNestSpec(
         name=f"trmm{n}",

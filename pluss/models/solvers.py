@@ -49,20 +49,21 @@ def trisolv(n: int = 128) -> LoopNestSpec:
     parallel iteration re-reads the prefix ``x[0..i)``.
     """
     span = share_span_formula(n)
-    x_i = lambda nm: Ref(nm, "x", addr_terms=((0, 1),))
+    x_i = lambda nm, w=False: Ref(nm, "x", addr_terms=((0, 1),),
+                                  is_write=w)
     jloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
         Ref("L0", "L", addr_terms=((0, n), (1, 1))),
         Ref("X1", "x", addr_terms=((1, 1),), share_span=span),
         x_i("X2"),
-        x_i("X3"),
+        x_i("X3", w=True),
     ))
     nest = Loop(trip=n, body=(
         Ref("B0", "b", addr_terms=((0, 1),)),
-        x_i("X0"),
+        x_i("X0", w=True),
         jloop,
         x_i("X4"),
         Ref("L1", "L", addr_terms=((0, n + 1),)),      # diagonal L[i][i]
-        x_i("X5"),
+        x_i("X5", w=True),
     ))
     return LoopNestSpec(
         name=f"trisolv{n}",
@@ -95,18 +96,20 @@ def durbin(n: int = 128) -> LoopNestSpec:
     z_loop = Loop(trip=max(n - 1, 1), bound_coef=(1, 1), body=(
         Ref("Y1", "y", addr_terms=((1, 1),), share_span=span),
         back("Y2", "y"),
-        Ref("Z0", "z", addr_terms=((1, 1),), share_span=span),
+        Ref("Z0", "z", addr_terms=((1, 1),), share_span=span,
+            is_write=True),
     ))
     copy_loop = Loop(trip=max(n - 1, 1), bound_coef=(1, 1), body=(
         Ref("Z1", "z", addr_terms=((1, 1),), share_span=span),
-        Ref("Y3", "y", addr_terms=((1, 1),), share_span=span),
+        Ref("Y3", "y", addr_terms=((1, 1),), share_span=span,
+            is_write=True),
     ))
     nest = Loop(trip=n - 1, start=1, body=(
         sum_loop,
         Ref("R1", "r", addr_terms=((0, 1),)),
         z_loop,
         copy_loop,
-        Ref("Y4", "y", addr_terms=((0, 1),)),
+        Ref("Y4", "y", addr_terms=((0, 1),), is_write=True),
     ))
     return LoopNestSpec(
         name=f"durbin{n}",
@@ -133,28 +136,31 @@ def gramschmidt(n: int = 128) -> LoopNestSpec:
     span = share_span_formula(n)
     a_ik = lambda nm: Ref(nm, "A", addr_terms=((1, n), (0, 1)),
                           share_span=span)
-    r_kk = lambda nm: Ref(nm, "R", addr_terms=((0, n + 1),))
+    r_kk = lambda nm, w=False: Ref(nm, "R", addr_terms=((0, n + 1),),
+                                   is_write=w)
     norm_loop = Loop(trip=n, body=(a_ik("A0"), a_ik("A1")))
     q_loop = Loop(trip=n, body=(
         a_ik("A2"),
         r_kk("R1"),
-        Ref("Q0", "Q", addr_terms=((1, n), (0, 1))),
+        Ref("Q0", "Q", addr_terms=((1, n), (0, 1)), is_write=True),
     ))
     q_ik = lambda nm: Ref(nm, "Q", addr_terms=((2, n), (0, 1)))
-    r_kj = lambda nm: Ref(nm, "R", addr_terms=((0, n), (1, 1)))
-    a_ij = lambda nm: Ref(nm, "A", addr_terms=((2, n), (1, 1)),
-                          share_span=span)
+    r_kj = lambda nm, w=False: Ref(nm, "R", addr_terms=((0, n), (1, 1)),
+                                   is_write=w)
+    a_ij = lambda nm, w=False: Ref(nm, "A", addr_terms=((2, n), (1, 1)),
+                               share_span=span, is_write=w)
     proj_loop = Loop(trip=n, body=(
-        q_ik("Q1"), a_ij("A3"), r_kj("R3"), r_kj("R4"),
+        q_ik("Q1"), a_ij("A3"), r_kj("R3"), r_kj("R4", w=True),
     ))
     update_loop = Loop(trip=n, body=(
-        a_ij("A4"), q_ik("Q2"), r_kj("R5"), a_ij("A5"),
+        a_ij("A4"), q_ik("Q2"), r_kj("R5"), a_ij("A5", w=True),
     ))
     jloop = Loop(
         trip=max(n - 1, 1), start=1, start_coef=1, bound_coef=(n - 1, -1),
-        body=(r_kj("R2"), proj_loop, update_loop),
+        body=(r_kj("R2", w=True), proj_loop, update_loop),
     )
-    nest = Loop(trip=n, body=(norm_loop, r_kk("R0"), q_loop, jloop))
+    nest = Loop(trip=n, body=(norm_loop, r_kk("R0", w=True), q_loop,
+                              jloop))
     return LoopNestSpec(
         name=f"gramschmidt{n}",
         arrays=(("A", n * n), ("R", n * n), ("Q", n * n)),
@@ -177,28 +183,31 @@ def cholesky(n: int = 128) -> LoopNestSpec:
     thread-private.
     """
     span = share_span_formula(n)
-    a_ij = lambda nm: Ref(nm, "A", addr_terms=((0, n), (1, 1)))
-    a_ii = lambda nm: Ref(nm, "A", addr_terms=((0, n + 1),))
+    a_ij = lambda nm, w=False: Ref(nm, "A", addr_terms=((0, n), (1, 1)),
+                                   is_write=w)
+    a_ii = lambda nm, w=False: Ref(nm, "A", addr_terms=((0, n + 1),),
+                                   is_write=w)
     kloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), bound_level=1,
                  body=(
         Ref("A0", "A", addr_terms=((0, n), (2, 1))),
         Ref("A1", "A", addr_terms=((1, n), (2, 1)), share_span=span),
         a_ij("A2"),
-        a_ij("A3"),
+        a_ij("A3", w=True),
     ))
     jloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
         kloop,
         a_ij("A4"),
         Ref("A5", "A", addr_terms=((1, n + 1),), share_span=span),
-        a_ij("A6"),
+        a_ij("A6", w=True),
     ))
     k2loop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
         Ref("A7", "A", addr_terms=((0, n), (1, 1))),
         Ref("A8", "A", addr_terms=((0, n), (1, 1))),
         a_ii("A9"),
-        a_ii("A10"),
+        a_ii("A10", w=True),
     ))
-    nest = Loop(trip=n, body=(jloop, k2loop, a_ii("A11"), a_ii("A12")))
+    nest = Loop(trip=n, body=(jloop, k2loop, a_ii("A11"),
+                              a_ii("A12", w=True)))
     return LoopNestSpec(
         name=f"cholesky{n}",
         arrays=(("A", n * n),),
@@ -219,7 +228,8 @@ def lu(n: int = 128) -> LoopNestSpec:
     carry the share span.
     """
     span = share_span_formula(n)
-    a_ij = lambda nm: Ref(nm, "A", addr_terms=((0, n), (1, 1)))
+    a_ij = lambda nm, w=False: Ref(nm, "A", addr_terms=((0, n), (1, 1)),
+                                   is_write=w)
     a_kj = lambda nm: Ref(nm, "A", addr_terms=((2, n), (1, 1)),
                           share_span=span)
     kloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), bound_level=1,
@@ -227,19 +237,19 @@ def lu(n: int = 128) -> LoopNestSpec:
         Ref("A0", "A", addr_terms=((0, n), (2, 1))),
         a_kj("A1"),
         a_ij("A2"),
-        a_ij("A3"),
+        a_ij("A3", w=True),
     ))
     jloop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
         kloop,
         a_ij("A4"),
         Ref("A5", "A", addr_terms=((1, n + 1),), share_span=span),
-        a_ij("A6"),
+        a_ij("A6", w=True),
     ))
     k2loop = Loop(trip=max(n - 1, 1), bound_coef=(0, 1), body=(
         Ref("A7", "A", addr_terms=((0, n), (2, 1))),
         a_kj("A8"),
         a_ij("A9"),
-        a_ij("A10"),
+        a_ij("A10", w=True),
     ))
     j2loop = Loop(trip=n, start_coef=1, bound_coef=(n, -1), body=(k2loop,))
     nest = Loop(trip=n, body=(jloop, j2loop))
@@ -280,7 +290,7 @@ def ludcmp(n: int = 128) -> LoopNestSpec:
     fwd = Loop(trip=n, body=(
         Ref("B0", "b", addr_terms=((0, 1),)),
         fwd_j,
-        Ref("Y0", "y", addr_terms=((0, 1),)),
+        Ref("Y0", "y", addr_terms=((0, 1),), is_write=True),
     ))
 
     back_j = Loop(trip=max(n - 1, 1), start=n, start_coef=-1,
@@ -292,7 +302,7 @@ def ludcmp(n: int = 128) -> LoopNestSpec:
         Ref("Y1", "y", addr_terms=((0, 1),)),
         back_j,
         Ref("U1", "A", addr_terms=((0, n + 1),)),
-        Ref("X1", "x", addr_terms=((0, 1),)),
+        Ref("X1", "x", addr_terms=((0, 1),), is_write=True),
     ))
     return LoopNestSpec(
         name=f"ludcmp{n}",
@@ -321,7 +331,7 @@ def seidel2d(n: int = 64, tsteps: int = 8) -> LoopNestSpec:
         body.append(Ref(f"A{nm}", "A", addr_terms=((1, n), (2, 1)),
                         addr_base=off(di, dj), share_span=span))
     body.append(Ref("Ao", "A", addr_terms=((1, n), (2, 1)),
-                    addr_base=off(0, 0), share_span=span))
+                    addr_base=off(0, 0), share_span=span, is_write=True))
     nest = Loop(trip=tsteps, body=(
         Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),)),
     ))
@@ -345,13 +355,13 @@ def floyd_warshall(n: int = 128) -> LoopNestSpec:
     distance test classifies them individually.
     """
     span = share_span_formula(n)
-    p_ij = lambda nm: Ref(nm, "path", addr_terms=((1, n), (2, 1)),
-                          share_span=span)
+    p_ij = lambda nm, w=False: Ref(nm, "path", addr_terms=((1, n), (2, 1)),
+                               share_span=span, is_write=w)
     inner = Loop(trip=n, body=(
         p_ij("P0"),
         Ref("P1", "path", addr_terms=((1, n), (0, 1)), share_span=span),
         Ref("P2", "path", addr_terms=((0, n), (2, 1)), share_span=span),
-        p_ij("P3"),
+        p_ij("P3", w=True),
     ))
     nest = Loop(trip=n, body=(Loop(trip=n, body=(inner,)),))
     return LoopNestSpec(

@@ -35,7 +35,8 @@ def conv2d(n: int = 128) -> LoopNestSpec:
                     share_span=span if di != 0 else None,
                 )
             )
-    body.append(Ref("O0", "out", addr_terms=((0, m), (1, 1))))
+    body.append(Ref("O0", "out", addr_terms=((0, m), (1, 1)),
+                    is_write=True))
     nest = Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),))
     return LoopNestSpec(
         name=f"conv2d{n}",
@@ -71,7 +72,8 @@ def stencil3d(n: int = 32) -> LoopNestSpec:
             )
         )
     body.append(
-        Ref("O0", "out", addr_terms=((0, m * m), (1, m), (2, 1)))
+        Ref("O0", "out", addr_terms=((0, m * m), (1, m), (2, 1)),
+            is_write=True)
     )
     nest = Loop(
         trip=m,
@@ -88,8 +90,14 @@ def fdtd2d(n: int = 64, tsteps: int = 2) -> LoopNestSpec:
     """fdtd-2d: per timestep, three interleaved sweeps over ey/ex/hz —
     time-stepped multi-nest with halo reads (ppcg-style rectangular interior;
     the boundary row/col updates of PolyBench's first loop are folded into
-    the interior sweeps for rectangularity)."""
-    m = n - 1
+    the interior sweeps for rectangularity).
+
+    The interior is ``m = n - 2`` per dimension: sweeps are centered at
+    ``(i+1, j+1)`` and the hz sweep reads the ``+1`` neighbors
+    (``ex[i][j+1]``, ``ey[i+1][j]``), so an ``n - 1`` interior would walk
+    one full row/column past the ``n x n`` arrays — the spec analyzer's
+    bounds prover (``pluss lint``, PL101) rejects exactly that shape."""
+    m = n - 2
     span = share_span_formula(m)
     terms = ((0, n), (1, 1))
     off = lambda di, dj: (di + 1) * n + (dj + 1)
@@ -101,7 +109,7 @@ def fdtd2d(n: int = 64, tsteps: int = 2) -> LoopNestSpec:
                             addr_base=off(di, dj),
                             share_span=span if di != 0 else None))
         body.append(Ref(f"{dst}s{t}", dst, addr_terms=terms,
-                        addr_base=off(0, 0)))
+                        addr_base=off(0, 0), is_write=True))
         return Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),))
 
     nests = []
@@ -137,7 +145,7 @@ def heat3d(n: int = 24, tsteps: int = 2) -> LoopNestSpec:
                             addr_base=off(*d),
                             share_span=span if d[0] != 0 else None))
         body.append(Ref(f"{dst}o{t}", dst, addr_terms=terms,
-                        addr_base=off(0, 0, 0)))
+                        addr_base=off(0, 0, 0), is_write=True))
         return Loop(trip=m, body=(
             Loop(trip=m, body=(Loop(trip=m, body=tuple(body)),)),
         ))

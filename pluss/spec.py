@@ -36,6 +36,24 @@ from typing import Union
 from pluss.config import SamplerConfig, DEFAULT
 
 
+class SpecContractError(ValueError):
+    """A Loop/Ref tree outside the engine's declarative contract.
+
+    ``code`` is the stable diagnostic code (PL4xx, see
+    :mod:`pluss.analysis.diagnostics`) the static analyzer surfaces the
+    violation under; plan-time callers keep seeing a plain ``ValueError``
+    (this is a subclass), so nothing about the failure mode changes for
+    them — the code is extra, machine-readable identity.
+    """
+
+    code = "PL407"  # generic "spec rejected by flatten" fallback
+
+    def __init__(self, message: str, code: str | None = None):
+        super().__init__(message)
+        if code is not None:
+            self.code = code
+
+
 @dataclasses.dataclass(frozen=True)
 class Ref:
     """One static memory reference inside a loop body.
@@ -50,6 +68,12 @@ class Ref:
     cross-thread sharing against this span (see module docstring).  The GEMM
     value 16513 comes from the generated comment ``(((1)*((128-0)/1)+1)*((128-0)/1)+1)``
     (``…omp.cpp:202``), i.e. ``(trip+1)*trip + 1`` of the carrying loop.
+
+    ``is_write``: True for stores.  The engine's reuse/share walk does not
+    distinguish loads from stores (neither does the reference's state
+    machine), but the static analyzer (:mod:`pluss.analysis`) needs the
+    distinction to prove or refute cross-thread races on the parallel
+    dimension, so every model spec declares it.
     """
 
     name: str
@@ -57,6 +81,7 @@ class Ref:
     addr_terms: tuple[tuple[int, int], ...]
     addr_base: int = 0
     share_span: int | None = None
+    is_write: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -248,18 +273,20 @@ def flatten_nest(nest: Loop) -> list[FlatRef]:
         return flatten_nest_quad(nest)
     out: list[FlatRef] = []
     if nest.bound_coef is not None or nest.start_coef:
-        raise ValueError(
+        raise SpecContractError(
             "the parallel (outermost) loop must be rectangular; bound_coef/"
-            "start_coef are for inner loops"
+            "start_coef are for inner loops",
+            "PL401",
         )
 
     def check_bound(loop: Loop) -> None:
         a, b = loop.bound_coef
         ends = (a, a + b * (nest.trip - 1))
         if min(ends) < 0 or max(ends) > loop.trip:
-            raise ValueError(
+            raise SpecContractError(
                 f"bound {loop.bound_coef} leaves [0, trip={loop.trip}] over "
-                f"parallel indices [0, {nest.trip})"
+                f"parallel indices [0, {nest.trip})",
+                "PL402",
             )
 
     def walk(loop: Loop, chain: list[Loop], off0: int, off1: int) -> None:
@@ -280,10 +307,11 @@ def flatten_nest(nest: Loop) -> list[FlatRef]:
                     s_aff.append((s0, s1))
                 coefs = [0] * len(chain)
                 for depth, coef in item.addr_terms:
-                    if depth >= len(chain):
-                        raise ValueError(
+                    if not 0 <= depth < len(chain):
+                        raise SpecContractError(
                             f"ref {item.name}: addr term depth {depth} exceeds "
-                            f"loop chain depth {len(chain)}"
+                            f"loop chain depth {len(chain)}",
+                            "PL403",
                         )
                     coefs[depth] += coef
                 out.append(
@@ -444,7 +472,9 @@ def _tri_of_const(c: int) -> int:
     return c * (c - 1) // 2
 
 
-class _QuadContractError(ValueError):
+class _QuadContractError(SpecContractError):
+    code = "PL405"
+
     def __init__(self, what: str):
         super().__init__(
             f"outside the quadratic position contract: {what} (positions "
@@ -558,9 +588,10 @@ def flatten_nest_quad(nest: Loop) -> list[FlatRef]:
     """
     out: list[FlatRef] = []
     if nest.bound_coef is not None or nest.start_coef:
-        raise ValueError(
+        raise SpecContractError(
             "the parallel (outermost) loop must be rectangular; bound_coef/"
-            "start_coef are for inner loops"
+            "start_coef are for inner loops",
+            "PL401",
         )
 
     def tdesc_of(loop: Loop, level: int, chain: list[Loop]):
@@ -571,9 +602,10 @@ def flatten_nest_quad(nest: Loop) -> list[FlatRef]:
             return ("g", a, b)
         m = loop.bound_level
         if not 0 < m < level:
-            raise ValueError(
+            raise SpecContractError(
                 f"bound_level {m} must name an enclosing loop "
-                f"(this loop sits at depth {level})"
+                f"(this loop sits at depth {level})",
+                "PL404",
             )
         ref = chain[m]
         if ref.start or ref.step != 1 or ref.start_coef:
@@ -606,27 +638,30 @@ def flatten_nest_quad(nest: Loop) -> list[FlatRef]:
     def check_bound(loop: Loop, level: int, chain: list[Loop]) -> None:
         a, b = loop.bound_coef
         if not 0 <= loop.bound_level < level:
-            raise ValueError(
+            raise SpecContractError(
                 f"bound_level {loop.bound_level} must name an enclosing "
-                f"loop (this loop sits at depth {level})"
+                f"loop (this loop sits at depth {level})",
+                "PL404",
             )
         hi = static_max_index(loop.bound_level, chain) \
             if loop.bound_level else nest.trip - 1
         ends = (a, a + b * hi)
         if min(ends) < 0 or max(ends) > loop.trip:
-            raise ValueError(
+            raise SpecContractError(
                 f"bound {loop.bound_coef} leaves [0, trip={loop.trip}] over "
-                f"referenced indices [0, {hi}]"
+                f"referenced indices [0, {hi}]",
+                "PL402",
             )
 
     def emit(item: Ref, chain: list[Loop], form: dict) -> None:
         d = len(chain)
         coefs = [0] * d
         for depth, coef in item.addr_terms:
-            if depth >= d:
-                raise ValueError(
+            if not 0 <= depth < d:
+                raise SpecContractError(
                     f"ref {item.name}: addr term depth {depth} exceeds "
-                    f"loop chain depth {d}"
+                    f"loop chain depth {d}",
+                    "PL403",
                 )
             coefs[depth] += coef
         bounds = []

@@ -11,6 +11,11 @@ N=${2:-128}
 MODEL=${MODEL:-gemm}
 CLI_FLAGS=${PLUSS_CLI_FLAGS---cpu}
 
+# static spec verification first (pure host analysis, no accelerator, ~1 s):
+# a broken spec must fail the driver BEFORE any native build or engine run.
+# Diagnostics go to stderr so output.txt keeps only the diffable blocks.
+python -m pluss.cli lint --all 1>&2
+
 # always try make (incremental, no-op when fresh): a stale prebuilt binary
 # would mis-parse the --spec flag used for non-gemm models.  A failed build
 # only warns — the Python CLI block below must still run and diagnose.
