@@ -180,21 +180,26 @@ def timed_reps(step, reps: int, label: str):
 
 
 def emit(metric: str, refs: int, best_s: float, base_s: float | None,
-         path: str = "", **extra) -> None:
+         path: str = "", degradations: tuple = (), **extra) -> None:
     """One JSON metric line.  ``path`` names the code path measured
     (engine.describe_path label, or a trace-pipeline name) so the record
     is self-describing — "sortpath" metric names notwithstanding
-    (VERDICT r5 task 4; names stay stable for round-over-round diffs)."""
+    (VERDICT r5 task 4; names stay stable for round-over-round diffs).
+    ``degradations`` carries the resilience ladder's stamp (empty for a
+    clean run), so a degraded run is visible in the perf trajectory
+    instead of masquerading as a regression."""
     vs = base_s / best_s if base_s else None
     refs_per_sec = refs / best_s
     log(f"bench: {metric} best {refs_per_sec:.3e} refs/s"
-        + (f", native {base_s:.3f} s/run -> speedup {vs:.2f}x" if vs else ""))
+        + (f", native {base_s:.3f} s/run -> speedup {vs:.2f}x" if vs else "")
+        + (f" [degraded: {','.join(degradations)}]" if degradations else ""))
     print(json.dumps({
         "metric": metric,
         "value": round(refs_per_sec, 1),
         "unit": "refs/s",
         "vs_baseline": round(vs, 3) if vs is not None else None,
         "path": path,
+        "degradations": list(degradations),
         **extra,
     }), flush=True)
 
@@ -422,7 +427,9 @@ def bench_trace(n_refs: int) -> None:
     # the deadline (1.3x the projected budget) is the backstop for the
     # feed SLOWING mid-run — a pre-run projection cannot see that
     # (observed: projected at ~23 MB/s, finished at ~5 MB/s, 3x over)
-    rep = trace.replay_file(
+    from pluss.resilience import replay_file_resilient
+
+    rep = replay_file_resilient(
         path, limit_refs=n_run,
         deadline_s=min(budget_s * 1.3, max(remaining_s() - 30, 1)))
     best_s = time.perf_counter() - t0
@@ -436,7 +443,7 @@ def bench_trace(n_refs: int) -> None:
     # stays keyed on one string; refs_requested + shrunk let downstream
     # tooling filter budget-shrunk runs without parsing stderr
     emit(f"trace{n_refs}_replay_refs_per_sec", n_run, best_s, base_s,
-         path="trace_stream",
+         path="trace_stream", degradations=tuple(rep.degradations),
          refs_replayed=n_run, refs_requested=n_refs,
          shrunk=bool(n_run != n_refs))
 
@@ -468,9 +475,14 @@ def main() -> int:
     from pluss.config import DEFAULT
     from pluss.models import gemm, syrk
 
+    from pluss.resilience import run_resilient
+
     def step_of(spec, backend="vmap"):
         def step():
-            res = engine.run(spec, backend=backend)
+            # the degradation ladder keeps the metric line alive under
+            # OOM/compile failures (stamped, so a degraded number is
+            # visible in the trajectory, never silently slower)
+            res = run_resilient(spec, backend=backend)
             cri.distribute(res.noshare_list(), res.share_list(),
                            DEFAULT.thread_num)
             return res
@@ -481,7 +493,8 @@ def main() -> int:
         emit("gemm128_sampler_refs_per_sec_cpu_fallback",
              res.max_iteration_count, best_s,
              cached_native_s("gemm128", lambda: native_baseline_s(128)),
-             path=engine.describe_path(gemm(128)))
+             path=engine.describe_path(gemm(128)),
+             degradations=tuple(res.degradations))
         return 0
 
     # headline FIRST (round 3's record has rc=124 with this metric still
@@ -502,7 +515,7 @@ def main() -> int:
                     res.max_iteration_count, best_s,
                     cached_native_s("gemm1024",
                                     lambda: native_baseline_s(1024)),
-                    flag_path)
+                    flag_path, tuple(res.degradations))
         emit(*flagship)
     except Exception as e:
         log(f"bench: FLAGSHIP gemm1024 metric failed: {e}")
@@ -522,7 +535,8 @@ def main() -> int:
             emit(f"syrk{n_syrk}_sortpath_refs_per_sec",
                  res.max_iteration_count, best_s,
                  native_s_of("syrk1024", syrk(n_syrk)),
-                 path=engine.describe_path(syrk(n_syrk)))
+                 path=engine.describe_path(syrk(n_syrk)),
+                 degradations=tuple(res.degradations))
         except Exception as e:  # never let an aux metric sink the record
             log(f"bench: syrk metric failed: {e}")
 
@@ -540,7 +554,8 @@ def main() -> int:
             emit("syrktri1024_sortpath_refs_per_sec",
                  res.max_iteration_count, best_s,
                  native_s_of("syrktri1024", spec_tri),
-                 path=engine.describe_path(spec_tri))
+                 path=engine.describe_path(spec_tri),
+                 degradations=tuple(res.degradations))
         except Exception as e:
             log(f"bench: triangular metric failed: {e}")
 

@@ -187,6 +187,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="resume sampling from this parallel-loop iteration "
                         "value (the reference's setStartPoint capability)")
     p.add_argument("--out", default="mrc.csv", help="mrc-mode output file")
+    p.add_argument("--resume", action="store_true",
+                   help="sweep mode: journal every finished point and skip "
+                        "points already journaled (interrupted sweeps "
+                        "recompute zero finished points); trace mode: "
+                        "checkpoint the replay every few batches and "
+                        "continue from an existing checkpoint")
+    p.add_argument("--journal", default=None,
+                   help="sweep journal / trace checkpoint path override "
+                        "(defaults derive from the model or trace file)")
     p.add_argument("--cpu", action="store_true",
                    help="force the host CPU backend (8 virtual devices)")
     p.add_argument("--profile", metavar="DIR",
@@ -283,9 +292,21 @@ def main(argv: list[str] | None = None) -> int:
         ts = [int(x) for x in args.sweep_threads.split(",") if x]
         cks = [int(x) for x in args.sweep_chunks.split(",") if x]
         cls_ = [int(x) for x in args.cache_lines.split(",") if x]
-        pts = sweep_mod.sweep(spec, ts, cks, cfg, args.share_cap)
+        journal = args.journal
+        if journal is None and args.resume:
+            journal = f".pluss_sweep_{args.model}_{args.n}.jsonl"
+        if args.resume:
+            print(f"pluss: sweep journal at {journal} (resume on)",
+                  file=sys.stderr)
+        pts = sweep_mod.sweep(spec, ts, cks, cfg, args.share_cap,
+                              journal=journal, resume=args.resume)
         out.write(f"{spec.name}: predicted miss ratios\n")
         out.write(sweep_mod.table(pts, cls_) + "\n")
+        # one report surface for the static analyzer's carried-level
+        # classifications (PL303) and the resilience stamps in the table
+        levels = sweep_mod.carried_levels(spec)
+        if levels:
+            out.write(levels + "\n")
     else:  # trace: dynamic replay (BASELINE config 5; bypasses CRI like the
         # reference's pluss_access path — see pluss/trace.py)
         if not args.file:
@@ -315,9 +336,25 @@ def main(argv: list[str] | None = None) -> int:
                 trace_mod.load_trace(args.file, args.fmt), cls=cfg.cls,
                 window=win)
         else:
-            rep = trace_mod.replay_file(args.file, args.fmt, cls=cfg.cls,
-                                        window=win)
+            from pluss.resilience import replay_file_resilient
+
+            # --journal alone arms checkpoint WRITING (crash insurance on
+            # a first long run); --resume additionally loads an existing
+            # checkpoint — same semantics split as the sweep mode
+            ckpt = None
+            if args.resume or args.journal:
+                ckpt = args.journal or (args.file + ".ckpt.npz")
+                print(f"pluss: trace checkpoint at {ckpt} "
+                      f"(resume {'on' if args.resume else 'off'})",
+                      file=sys.stderr)
+            rep = replay_file_resilient(args.file, args.fmt, cls=cfg.cls,
+                                        window=win, checkpoint_path=ckpt,
+                                        resume=args.resume)
         dt = time.perf_counter() - t0
+        if getattr(rep, "degradations", ()):
+            # stderr: the stdout block format is diffed byte-for-byte
+            print("pluss: trace replay degraded: "
+                  + ",".join(rep.degradations), file=sys.stderr)
         out.write(f"TPU TRACE: {dt:0.6f}\n")
         print_histogram("Start to dump reuse time", rep.histogram(), out)
         curve = mrc.aet_mrc(rep.histogram(), cfg)

@@ -128,11 +128,20 @@ def _plan_cache_get(key: str):
         return None
     import pickle
 
+    from pluss.resilience import faults
+
+    faults.corrupt("plan_cache.get", path)   # chaos: corrupt_cache site
     try:
         with open(path, "rb") as f:
             return pickle.load(f)
-    except Exception:
-        return None  # corrupt/partial cache entry: rebuild
+    except Exception as e:
+        # QUARANTINE, don't silently rebuild every run: rename the bad
+        # bytes aside (diagnosable later) so the rebuilt artifact can land
+        # in the now-free slot, and say what happened once
+        from pluss.resilience.errors import quarantine_artifact
+
+        quarantine_artifact(path, "engine plan-cache", e)
+        return None
 
 
 def _plan_cache_put(key: str, value) -> None:
@@ -140,11 +149,18 @@ def _plan_cache_put(key: str, value) -> None:
     if path is None:
         return
     import pickle
+    import uuid
 
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        pickle.dump(value, f)
-    os.replace(tmp, path)
+    # pid alone collides across THREADS of one process (the sweep runner
+    # plans concurrently); a uuid makes the tmp slot unique per writer
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -614,16 +630,24 @@ def plan_path(pl: StreamPlan) -> str:
 
 
 def describe_path(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
-                  window_accesses: int | None = None) -> str:
+                  window_accesses: int | None = None,
+                  degradations: tuple = ()) -> str:
     """The :func:`plan_path` label a default :func:`run` of ``spec`` takes,
     with a ``sliced:`` prefix when the auto-dispatch ladder reroutes it to
-    :func:`run_sliced`.  Uses the shared plan memo, so calling it after a
-    run costs nothing extra."""
+    :func:`run_sliced`, and a ``[degraded: ...]`` suffix when the caller
+    passes a result's resilience stamp (``res.degradations``) — so degraded
+    runs are self-describing wherever the label lands (bench records, sweep
+    reports).  Uses the shared plan memo, so calling it after a run costs
+    nothing extra."""
     pl = _plan_cached(spec, cfg, None, None, window_accesses, 1)
     label = plan_path(pl)
     if not os.environ.get("PLUSS_NO_AUTO_DISPATCH") \
             and _auto_dispatch(pl, cfg, None) is not None:
         label = "sliced:" + label
+    if degradations:
+        from pluss.resilience.ladder import degradation_label
+
+        label = degradation_label(label, tuple(degradations))
     return label
 
 
@@ -1403,7 +1427,8 @@ def _plan_cached(spec: LoopNestSpec, cfg: SamplerConfig, assignment,
 def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                share_cap: int = SHARE_CAP, assignment=None, start_point=None,
                window_accesses=None, thread_batch: int | None = None,
-               max_dispatch_entries: int | None = None) -> SamplerResult:
+               max_dispatch_entries: int | None = None,
+               _fault_checked: bool = False) -> SamplerResult:
     """Dispatch-sliced sampler run: the window stream executes as MANY short
     device dispatches instead of one monolithic executable.
 
@@ -1418,6 +1443,12 @@ def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     Bit-identical to :func:`run` — the slices replay the exact same window
     sequence against the same carries.
     """
+    if not _fault_checked:
+        # chaos injection site, once per LOGICAL attempt: run()'s
+        # auto-dispatch delegation already counted this attempt's hit
+        from pluss.resilience import faults
+
+        faults.check("engine.run")
     if assignment is not None:
         assignment = tuple(
             tuple(a) if a is not None else None for a in assignment
@@ -1505,6 +1536,9 @@ def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
     Normalizes ``thread_batch`` BEFORE the memo lookup so equivalent values
     (e.g. ``cfg.thread_num`` vs ``None``) share one compiled executable
     (advisor r3)."""
+    from pluss.resilience import faults
+
+    faults.check("engine.compile")   # chaos injection site
     return _compiled(spec, cfg, share_cap, assignment, start_point,
                      window_accesses, backend,
                      _normalize_thread_batch(thread_batch, cfg))
@@ -1567,6 +1601,10 @@ class SamplerResult:
     #: fraction of the stream actually walked — 1.0 for full enumeration;
     #: < 1 only for pluss.sampling estimates (float counts, scaled)
     sampled_fraction: float = 1.0
+    #: degradation-ladder rungs taken to produce this result (empty for a
+    #: clean first-attempt run) — stamped by pluss.resilience.run_resilient,
+    #: surfaced by describe_path(..., degradations=...) and bench records
+    degradations: tuple = ()
 
     @property
     def thread_num(self) -> int:
@@ -1782,6 +1820,9 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     memory budget — see :func:`_auto_dispatch`.  Disable with
     ``PLUSS_NO_AUTO_DISPATCH=1`` (or by picking a backend explicitly).
     """
+    from pluss.resilience import faults
+
+    faults.check("engine.run")   # chaos injection site (per entry attempt)
     if assignment is not None:
         assignment = tuple(
             tuple(a) if a is not None else None for a in assignment
@@ -1799,7 +1840,7 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                   f"(thread_batch={tb or cfg.thread_num}): {reason}",
                   file=sys.stderr)
             return run_sliced(spec, cfg, share_cap, assignment, start_point,
-                              window_accesses, tb)
+                              window_accesses, tb, _fault_checked=True)
     pl, f = compiled(spec, cfg, share_cap, assignment, start_point,
                      window_accesses, backend,
                      _normalize_thread_batch(thread_batch, cfg))
@@ -1832,6 +1873,9 @@ def _finalize(pl: StreamPlan, hist: np.ndarray, share_ys,
     """Shared tail of :func:`run` / :func:`run_sliced`: merge the per-window
     share outputs, add the host-side static share constants, settle overlay
     subtractions, and box the result."""
+    from pluss.resilience import faults
+
+    faults.check("engine.finalize")   # chaos injection site (share_cap)
     # share_ys: per nest (svals [T, NW, cap], scnts, snu [T, NW]), plus the
     # same triple of overlay SUBTRACTIONS for nests with overlays
     share_raw = merge_share_windows(

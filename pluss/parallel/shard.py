@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
+from pluss.utils import compat
 from pluss.engine import (
     SamplerResult,
     StreamPlan,
@@ -97,16 +98,10 @@ def _tpl_dense(tpl, tid, d, n_lines, pos_dtype, nb):
     return head_pos, head_span, tail_pos
 
 
-def _vary_leaf(y):
-    """Mark a leaf device-varying for shard_map vma unification (template
-    constants are device-invariant; sorted-stream values are varying)."""
-    if "d" in getattr(jax.typeof(y), "vma", frozenset()):
-        return y
-    return jax.lax.pcast(y, ("d",), to="varying")
-
-
-def _vary(tree):
-    return jax.tree.map(_vary_leaf, tree)
+#: vma marking for shard_map unification (template constants are
+#: device-invariant; sorted-stream values are varying) — identity on jax
+#: versions without the vma system (pluss.utils.compat)
+_vary = compat.vary
 
 
 def _capture_heads(head_pos, head_span, cold, key_s, pos_s, span_s,
@@ -309,7 +304,7 @@ def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
     # would be pure waste here)
     pl = plan(spec, cfg, assignment, start_point, n_windows=D * S,
               build_overlays=False, build_rowpriv=False)
-    f = jax.shard_map(
+    f = compat.shard_map(
         lambda t: _shard_body(t, pl, share_cap, D, S),
         mesh=mesh,
         in_specs=P(),
@@ -330,6 +325,9 @@ def shard_run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     ``window_accesses`` overrides the per-sub-window access target
     (default engine.WINDOW_TARGET).
     """
+    from pluss.resilience import faults
+
+    faults.check("shard.run")   # chaos injection site (per entry attempt)
     mesh = mesh or default_mesh()
     if assignment is not None:
         assignment = tuple(

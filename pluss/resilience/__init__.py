@@ -1,0 +1,58 @@
+"""Resilience layer: classified failures, degradation ladders, checkpoints.
+
+The engine's value proposition is *prediction without execution* — a long
+static-sampling run (GEMM-4096 plan builds are minutes, 1e9-ref trace
+staging is ~2 min / 3 GB over the tunneled feed) that dies at 90% and
+restarts from zero erases that advantage.  This package is the recovery
+story every entry point shares:
+
+- :mod:`pluss.resilience.errors` — the structured ``PlussError`` taxonomy
+  (``retryable`` / ``degradable`` / ``fatal``) and :func:`classify`, which
+  wraps raw XLA ``RESOURCE_EXHAUSTED``, compile failures,
+  ``ShareCapExceeded``, collective/distributed failures, and trace
+  ``DataLoss`` so no raw XLA/OS exception escapes a resilient entry point.
+- :mod:`pluss.resilience.faults` — a deterministic seeded fault injector
+  (``PLUSS_FAULT_PLAN="oom@2,corrupt_cache,kill_worker@1"``) with named
+  sites in engine / shard / multihost / trace / plan-cache, driving the
+  chaos suite (tests/test_resilience.py) and ``soak.py --chaos``.
+- :mod:`pluss.resilience.ladder` — the degradation-ladder executor
+  wrapping ``engine.run`` / ``shard.shard_run`` / ``trace.replay_file``:
+  on OOM it shrinks the scan window, raises the window count, switches to
+  the dispatch-sliced pipeline, and finally falls back to CPU, folding the
+  share-cap auto-retry into the same bounded-retry-with-backoff machinery
+  and stamping every result with the degradations taken.
+- :mod:`pluss.resilience.journal` — the atomic JSONL checkpoint journal
+  behind ``sweep --resume`` and the trace staging/replay checkpoints.
+
+Everything here is host-side control flow — no new device code, no new
+dependencies — so the same recovery semantics hold on CPU and TPU.
+"""
+
+from __future__ import annotations
+
+from pluss.resilience.errors import (
+    CacheCorrupt,
+    CollectiveError,
+    CompileError,
+    DataLoss,
+    PlussError,
+    ResourceExhausted,
+    ShareCapOverflow,
+    WorkerDied,
+    classify,
+)
+from pluss.resilience.faults import FaultPlan
+from pluss.resilience.journal import Journal
+from pluss.resilience.ladder import (
+    LADDER,
+    Retry,
+    replay_file_resilient,
+    run_resilient,
+)
+
+__all__ = [
+    "PlussError", "ResourceExhausted", "CompileError", "ShareCapOverflow",
+    "CollectiveError", "WorkerDied", "DataLoss", "CacheCorrupt", "classify",
+    "FaultPlan", "Journal", "LADDER", "Retry", "run_resilient",
+    "replay_file_resilient",
+]

@@ -1,0 +1,196 @@
+"""Structured error taxonomy: every failure a resilient entry point can see.
+
+The classification contract (README "Failure model & recovery", test-synced
+by tests/test_resilience.py) is three orthogonal bits on every
+:class:`PlussError`:
+
+- ``retryable``  — the SAME attempt may succeed if repeated (possibly with
+  an adjusted knob the error itself names, e.g. a larger share cap or a
+  fresh connect): transient collective failures, share-cap overflow,
+  quarantined cache entries.
+- ``degradable`` — repeating identically will fail again, but a
+  degradation-ladder rung (smaller windows, sliced dispatch, CPU) routes
+  around it: device OOM, compile failures.
+- ``fatal``      — neither: the input itself is broken (truncated trace,
+  spec contract violation) or every rung is exhausted.  Fatal errors
+  propagate *classified* — callers still get the site and cause, never a
+  raw XLA/OS traceback as the primary error.
+
+:func:`classify` is the single funnel mapping raw exceptions (XLA
+``RESOURCE_EXHAUSTED``, jaxlib compile errors, ``ShareCapExceeded``,
+distributed-init races, OS errors from trace I/O) into the taxonomy; the
+ladder and every chaos assertion key on the resulting types, not on
+message text.
+"""
+
+from __future__ import annotations
+
+
+class PlussError(Exception):
+    """Base of the classified-failure taxonomy.
+
+    ``site`` names where the failure surfaced (an injection-site name such
+    as ``engine.run`` or ``trace.read_batch``); ``cause`` keeps the raw
+    exception for post-mortems (also chained via ``__cause__`` when
+    classified by :func:`classify`).
+    """
+
+    retryable = False
+    degradable = False
+
+    def __init__(self, message: str, site: str = "",
+                 cause: BaseException | None = None):
+        super().__init__(message)
+        self.site = site
+        self.cause = cause
+
+    @property
+    def fatal(self) -> bool:
+        return not (self.retryable or self.degradable)
+
+    def __str__(self) -> str:
+        base = super().__str__()
+        return f"[{self.site}] {base}" if self.site else base
+
+
+class ResourceExhausted(PlussError):
+    """Device (or host) memory exhausted: XLA ``RESOURCE_EXHAUSTED``, the
+    engine's own sort-budget guard, or ``MemoryError``.  Degradable — the
+    ladder shrinks windows / concurrency until the allocation fits."""
+
+    degradable = True
+
+
+class CompileError(PlussError):
+    """XLA/Mosaic compilation failed.  Degradable — a different execution
+    shape (sliced dispatch, CPU backend) compiles a different program."""
+
+    degradable = True
+
+
+class ShareCapOverflow(PlussError):
+    """A device window dropped share uniques beyond ``share_cap``
+    (:class:`pluss.engine.ShareCapExceeded`).  Retryable — the run must be
+    repeated at the larger cap the error names (``needed``); the ladder
+    folds the engine's existing auto-retry into its bounded-retry loop."""
+
+    retryable = True
+
+    def __init__(self, message: str, site: str = "",
+                 cause: BaseException | None = None, needed: int = 0):
+        super().__init__(message, site, cause)
+        self.needed = needed
+
+
+class CollectiveError(PlussError):
+    """Distributed bring-up or collective communication failed (connect
+    timeout, coordination-service race, DCN hiccup).  Retryable with
+    backoff — the standard transient-network contract."""
+
+    retryable = True
+
+
+class WorkerDied(PlussError):
+    """A participating process stopped heartbeating (killed worker, host
+    loss).  Degradable — the coordinator salvages by re-running on its
+    local devices (``local_salvage``); non-coordinators propagate fatal.
+
+    ``process_ids`` lists the dead processes when known."""
+
+    degradable = True
+
+    def __init__(self, message: str, site: str = "",
+                 cause: BaseException | None = None,
+                 process_ids: tuple[int, ...] = ()):
+        super().__init__(message, site, cause)
+        self.process_ids = process_ids
+
+
+class DataLoss(PlussError):
+    """Input bytes are missing or garbled (truncated u64 trace, garbage
+    text line, torn checkpoint).  Fatal — no retry or degradation can
+    invent the missing data; the message names the byte/line offset so the
+    operator can repair or re-capture."""
+
+
+class CacheCorrupt(PlussError):
+    """A disk cache entry failed to load and was quarantined (renamed to
+    ``*.corrupt``).  Retryable — the artifact rebuilds from scratch; the
+    quarantine preserves the bad bytes for diagnosis."""
+
+    retryable = True
+
+
+#: substring markers of XLA out-of-memory errors (jaxlib surfaces them as
+#: ``XlaRuntimeError`` whose str starts with the status code)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM ", "exceeds the", "device budget")
+_COMPILE_MARKERS = ("Compilation failure", "compilation failed",
+                    "Mosaic compilation", "XLA compilation",
+                    "INTERNAL: Failed to compile", "UNIMPLEMENTED")
+_COLLECTIVE_MARKERS = ("DEADLINE_EXCEEDED", "coordination service",
+                       "barrier", "collective", "UNAVAILABLE",
+                       "failed to connect", "Connection refused",
+                       "distributed", "heartbeat")
+
+
+def classify(exc: BaseException, site: str = "") -> PlussError:
+    """Map a raw exception to the taxonomy (idempotent on PlussErrors).
+
+    The returned error chains ``exc`` as ``__cause__``/``cause`` so the
+    original traceback is never lost — classification adds structure, it
+    does not discard evidence.
+    """
+    if isinstance(exc, PlussError):
+        if site and not exc.site:
+            exc.site = site
+        return exc
+    # lazy import: errors.py must stay importable with no engine (and the
+    # engine imports nothing from here, so there is no cycle either way)
+    from pluss.engine import ShareCapExceeded
+
+    msg = f"{type(exc).__name__}: {exc}"
+    out: PlussError
+    if isinstance(exc, ShareCapExceeded):
+        out = ShareCapOverflow(msg, site, exc, needed=exc.needed)
+    elif isinstance(exc, MemoryError) or _any(msg, _OOM_MARKERS):
+        out = ResourceExhausted(msg, site, exc)
+    elif _any(msg, _COMPILE_MARKERS):
+        out = CompileError(msg, site, exc)
+    elif isinstance(exc, (ConnectionError, TimeoutError)) \
+            or _any(msg, _COLLECTIVE_MARKERS):
+        out = CollectiveError(msg, site, exc)
+    elif isinstance(exc, (EOFError,)) or _any(msg, ("truncated", "DataLoss")):
+        out = DataLoss(msg, site, exc)
+    else:
+        # unknown failures stay fatal-but-classified: the resilient entry
+        # points re-raise them wrapped, so no raw exception escapes
+        out = PlussError(msg, site, exc)
+    out.__cause__ = exc
+    return out
+
+
+def _any(msg: str, markers: tuple[str, ...]) -> bool:
+    return any(m in msg for m in markers)
+
+
+def quarantine_artifact(path: str, label: str, exc: BaseException,
+                        action: str = "rebuilding") -> str:
+    """Shared policy for corrupt REBUILDABLE artifacts (plan-cache
+    entries, replay checkpoints, …): rename the bad bytes to
+    ``path + '.corrupt'`` so they stay diagnosable, say what happened
+    once on stderr, and let the caller rebuild from scratch.  Returns the
+    one-line notice (already printed)."""
+    import os
+    import sys
+
+    quarantine = path + ".corrupt"
+    try:
+        os.replace(path, quarantine)
+        where = f"quarantined to {quarantine}"
+    except OSError:
+        where = "quarantine rename failed; left in place"
+    msg = (f"{label}: corrupt artifact {path} "
+           f"({type(exc).__name__}: {exc}); {where}; {action}")
+    print(msg, file=sys.stderr)
+    return msg

@@ -1,0 +1,98 @@
+"""Atomic JSONL checkpoint journal: the resume substrate for long runs.
+
+One journal = one append-only file of JSON lines, each ``{"key": {...},
+**payload}``.  The write path is crash-safe by construction:
+
+- every record is a SINGLE line, written with one ``write()`` + flush +
+  fsync, so a crash can only tear the *final* line;
+- the read path tolerates exactly that: a trailing partial/garbled line is
+  dropped with a warning (it is the expected post-crash state), while a
+  corrupt line in the *middle* raises :class:`CacheCorrupt` naming the
+  line — that means something other than a crash-in-append touched the
+  file.  ``CacheCorrupt`` (retryable), not ``DataLoss`` (fatal): a
+  journal is a rebuildable artifact — deleting it and recomputing is
+  always a correct (just slower) recovery, unlike a truncated source
+  trace where the missing data is simply gone.
+
+Keys are canonicalized (sorted-key JSON) so dict ordering never splits a
+logical key in two.  Used by ``sweep --resume`` (one record per finished
+(model, n, threads, chunk) point) and the trace staging/replay
+checkpoints (one record per flushed batch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from pluss.resilience.errors import CacheCorrupt
+
+
+def _canon(key: dict) -> str:
+    return json.dumps(key, sort_keys=True, separators=(",", ":"))
+
+
+class Journal:
+    """Append-only JSONL journal with canonical-key lookup."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._by_key: dict[str, dict] = {}
+        self._n_lines = 0
+        self._load()
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        lines = raw.split(b"\n")
+        # a trailing newline leaves one empty tail element; drop it so the
+        # torn-line check below only sees real content
+        if lines and lines[-1] == b"":
+            lines.pop()
+        for i, line in enumerate(lines):
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict) or "key" not in rec:
+                    raise ValueError("not a journal record")
+            except ValueError as e:
+                if i == len(lines) - 1:
+                    # torn final line: the expected crash artifact —
+                    # resume simply recomputes that one record
+                    print(f"pluss journal: dropping torn final line of "
+                          f"{self.path} (crash artifact)", file=sys.stderr)
+                    break
+                raise CacheCorrupt(
+                    f"corrupt journal line {i + 1} of {self.path}: {e} "
+                    "(delete the journal to rebuild from scratch)",
+                    site="journal.load", cause=e)
+            self._by_key[_canon(rec["key"])] = rec
+            self._n_lines = i + 1
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    def get(self, key: dict) -> dict | None:
+        """The last record for ``key``, or None (later records win)."""
+        return self._by_key.get(_canon(key))
+
+    def done(self, key: dict) -> bool:
+        return _canon(key) in self._by_key
+
+    def record(self, key: dict, **payload) -> dict:
+        """Append one record durably (single write + flush + fsync)."""
+        rec = {"key": key, **payload}
+        line = json.dumps(rec, sort_keys=True) + "\n"
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        # append mode: a crash between open and write leaves the file
+        # untouched or with a torn final line — both handled by _load
+        with open(self.path, "a") as f:
+            f.write(line)
+            f.flush()
+            os.fsync(f.fileno())
+        self._by_key[_canon(key)] = rec
+        self._n_lines += 1
+        return rec

@@ -1,0 +1,267 @@
+"""The degradation ladder: bounded retry-with-backoff around every runner.
+
+One executor (:func:`run_resilient` for the sampler entry points,
+:func:`replay_file_resilient` for trace replay) owns ALL recovery policy:
+
+1. classify the raw failure (:func:`pluss.resilience.errors.classify`);
+2. **retryable** errors repeat the same attempt under a bounded
+   exponential backoff — share-cap overflow additionally raises the cap
+   exactly like the engine's internal auto-retry (the two are one
+   machinery now: the engine handles in-run overflow, the ladder handles
+   anything that escapes it);
+3. **degradable** errors descend the ladder, one rung per failure:
+
+   ========================  =============================================
+   rung                      effect
+   ========================  =============================================
+   ``shrink_window``         scan window /8 (more, smaller sort windows)
+   ``raise_n_windows``       window /8 again (window count rises further)
+   ``sliced_pipeline``       dispatch-sliced packed pipeline at
+                             ``thread_batch=1`` (``engine.run_sliced``)
+   ``cpu_fallback``          force the host CPU backend, default window
+   ========================  =============================================
+
+   (the ``shard`` backend's ladder is ``shrink_window`` →
+   ``single_device`` → ``cpu_fallback``; trace replay's is
+   ``shrink_window`` → ``cpu_fallback``);
+4. **fatal** errors — and a ladder that runs dry — propagate *classified*:
+   a resilient entry point never leaks a raw XLA/OS exception.
+
+Every rung preserves results bit-for-bit by construction (window size,
+dispatch slicing, and backend are all result-invariant knobs — the
+property suite asserts this independently), so a degraded run's histogram
+still matches the oracle exactly; the price is speed, and the stamp makes
+it visible: the returned result carries ``degradations`` (a tuple of rung
+names plus ``share_cap=N`` bumps), surfaced by ``engine.describe_path``'s
+``degradations`` argument, the sweep report, and bench metric lines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+from pluss.resilience.errors import (
+    PlussError,
+    ShareCapOverflow,
+    classify,
+)
+
+#: rung order of the default (vmap) ladder — the README table is
+#: test-synced against this tuple
+LADDER: tuple[str, ...] = ("shrink_window", "raise_n_windows",
+                           "sliced_pipeline", "cpu_fallback")
+
+#: ladder of the device-sharded backend: degrade toward fewer devices
+SHARD_LADDER: tuple[str, ...] = ("shrink_window", "single_device",
+                                 "cpu_fallback")
+
+#: ladder of trace replay (no thread dimension to slice)
+TRACE_LADDER: tuple[str, ...] = ("shrink_window", "cpu_fallback")
+
+
+@dataclasses.dataclass
+class Retry:
+    """Bounded exponential backoff shared by every resilient loop."""
+
+    max_attempts: int = 8
+    backoff_s: float = 0.05
+    backoff_cap_s: float = 2.0
+
+    def sleep(self, attempt: int) -> None:
+        if self.backoff_s > 0:
+            time.sleep(min(self.backoff_s * (2 ** attempt),
+                           self.backoff_cap_s))
+
+
+def _log(msg: str) -> None:
+    print(f"resilience: {msg}", file=sys.stderr, flush=True)
+
+
+#: set once a cpu_fallback rung pins this PROCESS to the CPU platform
+#: (force_cpu is one-way: un-pinning mid-process is exactly the wedged-
+#: tunnel hang the rung exists to escape).  Every later resilient result
+#: is stamped ``cpu_pinned`` so a whole sweep/bench run degraded by one
+#: early fallback stays visible — a clean-looking () stamp on a silently
+#: CPU-pinned process would be the masquerading regression this PR bans.
+_CPU_PINNED = False
+
+
+def _stamp(degradations: tuple[str, ...]) -> tuple[str, ...]:
+    if _CPU_PINNED and "cpu_fallback" not in degradations:
+        return ("cpu_pinned",) + degradations
+    return degradations
+
+
+def _next_share_cap(err: ShareCapOverflow, share_cap: int) -> int:
+    """The bounded share-cap raise (same policy as engine._auto_share_cap,
+    shared here so escapes of the internal retry converge identically)."""
+    from pluss.engine import MAX_AUTO_SHARE_CAP
+
+    new_cap = max(share_cap * 2, 1 << (max(err.needed, 2) - 1).bit_length())
+    if new_cap > MAX_AUTO_SHARE_CAP:
+        raise err
+    return new_cap
+
+
+def _resilient_loop(make_attempt, apply_rung, rungs: tuple[str, ...],
+                    retry: Retry, label: str):
+    """Shared control flow: returns (result, degradations tuple).
+
+    ``make_attempt(state)`` runs one attempt from the mutable state dict;
+    ``apply_rung(state, rung)`` mutates state for a degradation rung.
+    """
+    degradations: list[str] = []
+    rung_idx = 0
+    retries = 0
+    state: dict = {}
+    while True:
+        try:
+            return make_attempt(state), tuple(degradations)
+        except BaseException as e:  # noqa: BLE001 — classify funnels all
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            err = classify(e, site=label)
+            retries += 1
+            if retries >= retry.max_attempts:
+                _log(f"{label}: retry budget ({retry.max_attempts}) "
+                     f"exhausted at {err}")
+                raise err
+            if isinstance(err, ShareCapOverflow):
+                new_cap = _next_share_cap(err, state.get("share_cap", 0)
+                                          or err.needed)
+                state["share_cap"] = new_cap
+                degradations.append(f"share_cap={new_cap}")
+                _log(f"{label}: share cap overflow ({err.needed} uniques); "
+                     f"retrying at cap {new_cap}")
+            elif err.degradable and rung_idx < len(rungs):
+                rung = rungs[rung_idx]
+                rung_idx += 1
+                apply_rung(state, rung)
+                degradations.append(rung)
+                _log(f"{label}: {type(err).__name__} at "
+                     f"{err.site or label}; degrading -> {rung}")
+            elif err.retryable:
+                _log(f"{label}: transient {type(err).__name__}; "
+                     f"retry {retries}/{retry.max_attempts}")
+            else:
+                raise err
+            retry.sleep(retries - 1)
+
+
+def run_resilient(spec, cfg=None, share_cap: int | None = None, *,
+                  backend: str = "vmap", assignment=None, start_point=None,
+                  window_accesses: int | None = None, mesh=None,
+                  retry: Retry | None = None):
+    """Degradation-ladder wrapper of ``engine.run`` / ``shard.shard_run``.
+
+    Same signature surface as the wrapped runners; returns the same
+    :class:`~pluss.engine.SamplerResult`, with ``degradations`` stamped
+    (empty tuple for a clean first-attempt run).  Raises only
+    :class:`~pluss.resilience.errors.PlussError` subclasses.
+    """
+    from pluss.config import DEFAULT, SHARE_CAP
+
+    cfg = cfg if cfg is not None else DEFAULT
+    retry = retry or Retry()
+    rungs = SHARD_LADDER if backend == "shard" else LADDER
+
+    def make_attempt(state: dict):
+        from pluss import engine
+
+        cap = state.get("share_cap") or share_cap or SHARE_CAP
+        window = state.get("window", window_accesses)
+        mode = state.get("mode", backend)
+        if mode == "shard":
+            from pluss.parallel.shard import shard_run
+
+            return shard_run(spec, cfg, cap, mesh, assignment=assignment,
+                             start_point=start_point,
+                             window_accesses=window)
+        if mode == "sliced":
+            return engine.run_sliced(spec, cfg, cap, assignment,
+                                     start_point, window, thread_batch=1)
+        return engine.run(spec, cfg, cap, assignment, start_point,
+                          window, backend=mode if mode in ("vmap", "seq")
+                          else "vmap")
+
+    def apply_rung(state: dict, rung: str) -> None:
+        from pluss.engine import WINDOW_TARGET
+
+        if rung in ("shrink_window", "raise_n_windows"):
+            cur = state.get("window") or window_accesses or WINDOW_TARGET
+            state["window"] = max(cur // 8, 1 << 10)
+        elif rung == "sliced_pipeline":
+            state["mode"] = "sliced"
+        elif rung == "single_device":
+            state["mode"] = "vmap"
+        elif rung == "cpu_fallback":
+            import jax
+
+            from pluss.utils.platform import force_cpu
+
+            global _CPU_PINNED
+            was_cpu = jax.default_backend() == "cpu"
+            force_cpu()
+            if not was_cpu:   # the pin stamp is for an ACTUAL platform flip
+                _CPU_PINNED = True
+            state["mode"] = "vmap"
+            state.pop("window", None)  # CPU host memory: default window ok
+        else:
+            raise AssertionError(f"unknown rung {rung}")
+
+    res, degradations = _resilient_loop(
+        make_attempt, apply_rung, rungs, retry,
+        label=f"run[{spec.name}]")
+    res.degradations = _stamp(degradations)
+    return res
+
+
+def replay_file_resilient(path: str, fmt: str = "u64", *,
+                          retry: Retry | None = None, **kw):
+    """Degradation-ladder wrapper of ``trace.replay_file`` (and the
+    checkpointed variant when ``checkpoint_path``/``resume`` are passed
+    through ``kw``).  Stamps ``degradations`` on the ReplayResult."""
+    retry = retry or Retry()
+
+    def make_attempt(state: dict):
+        from pluss import trace
+
+        kw2 = dict(kw)
+        if "window" in state:
+            kw2["window"] = state["window"]
+        return trace.replay_file(path, fmt, **kw2)
+
+    def apply_rung(state: dict, rung: str) -> None:
+        from pluss import trace
+
+        if rung == "shrink_window":
+            cur = state.get("window", kw.get("window") or trace.TRACE_WINDOW)
+            state["window"] = max(cur // 4, 1 << 14)
+        elif rung == "cpu_fallback":
+            import jax
+
+            from pluss.utils.platform import force_cpu
+
+            global _CPU_PINNED
+            was_cpu = jax.default_backend() == "cpu"
+            force_cpu()
+            if not was_cpu:
+                _CPU_PINNED = True
+        else:
+            raise AssertionError(f"unknown rung {rung}")
+
+    res, degradations = _resilient_loop(
+        make_attempt, apply_rung, TRACE_LADDER, retry,
+        label=f"trace[{path}]")
+    res.degradations = _stamp(degradations)
+    return res
+
+
+def degradation_label(base: str, degradations: tuple[str, ...]) -> str:
+    """``describe_path``-style label with the degradation stamp appended
+    (``template+sort [degraded: shrink_window,cpu_fallback]``)."""
+    if not degradations:
+        return base
+    return f"{base} [degraded: {','.join(degradations)}]"

@@ -10,6 +10,16 @@ CRI model and AET solver per config, and compare the curves.
 The engine caches one executable per config (``engine.compiled``), so a sweep
 costs one compile per *shape* family plus fast reruns — the TPU analogue of
 the reference rebuilding per `-D` combination.
+
+Resilience (PR 2): each point runs under the degradation ladder
+(:func:`pluss.resilience.run_resilient`) and can journal its raw
+histograms to an atomic JSONL checkpoint — an interrupted multi-point
+sweep resumed with ``journal=``/``resume=True`` (CLI: ``pluss sweep
+--resume``) recomputes ZERO finished points: the curve is rebuilt from
+the journaled histograms through the same (deterministic, host-side)
+CRI + AET pipeline.  Points that degraded carry the rungs taken in
+``SweepPoint.degradations``, sharing one report surface with the static
+analyzer's PL303 carried-level classifications (:func:`carried_levels`).
 """
 
 from __future__ import annotations
@@ -19,7 +29,7 @@ from typing import Iterable, Sequence
 
 import numpy as np
 
-from pluss import cri, engine, mrc
+from pluss import cri, mrc
 from pluss.config import SHARE_CAP, SamplerConfig
 from pluss.spec import LoopNestSpec
 
@@ -31,6 +41,9 @@ class SweepPoint:
     cfg: SamplerConfig
     curve: np.ndarray            # miss ratio per cache size (aet_mrc)
     total_refs: int
+    #: degradation-ladder rungs the point's run took ('journal' when the
+    #: point was restored from a resume journal without recomputation)
+    degradations: tuple = ()
 
     def miss_ratio_at(self, cache_lines: int) -> float:
         """Predicted miss ratio at a cache of ``cache_lines`` entries."""
@@ -39,34 +52,107 @@ class SweepPoint:
         return float(self.curve[min(cache_lines, len(self.curve) - 1)])
 
 
+def _point_key(spec: LoopNestSpec, cfg: SamplerConfig) -> dict:
+    """Canonical journal key of one sweep point: the full (model, machine,
+    schedule) coordinate, so journals from different sweeps never alias."""
+    return {"model": spec.name, "threads": cfg.thread_num,
+            "chunk": cfg.chunk_size, "ds": cfg.ds, "cls": cfg.cls,
+            "cache_kb": cfg.cache_kb}
+
+
+def _intkeys(d: dict) -> dict:
+    """JSON round-trip turns int dict keys into strings; undo it."""
+    return {int(k): v for k, v in d.items()}
+
+
 def sweep(spec: LoopNestSpec,
           thread_nums: Sequence[int] = (1, 2, 4, 8),
           chunk_sizes: Sequence[int] = (4,),
           base_cfg: SamplerConfig = SamplerConfig(),
-          share_cap: int = SHARE_CAP) -> list[SweepPoint]:
-    """Predict the MRC of ``spec`` under each (thread_num, chunk_size)."""
+          share_cap: int = SHARE_CAP,
+          journal=None,
+          resume: bool = False) -> list[SweepPoint]:
+    """Predict the MRC of ``spec`` under each (thread_num, chunk_size).
+
+    ``journal``: a :class:`pluss.resilience.Journal` (or a path string) —
+    every finished point's raw per-thread histograms are recorded there
+    durably.  With ``resume=True``, points already journaled are restored
+    instead of recomputed (the sampler run is the expensive part; the
+    CRI + AET tail is deterministic host math and replays in
+    milliseconds), stamped ``degradations=('journal',) + <original>``.
+    """
+    from pluss.resilience import run_resilient
+    from pluss.resilience.journal import Journal
+
+    if isinstance(journal, str):
+        journal = Journal(journal)
     out = []
     for t in thread_nums:
         for cs in chunk_sizes:
             cfg = dataclasses.replace(base_cfg, thread_num=t, chunk_size=cs)
-            res = engine.run(spec, cfg, share_cap)
-            ri = cri.distribute(res.noshare_list(), res.share_list(), t)
-            out.append(SweepPoint(cfg, mrc.aet_mrc(ri, cfg),
-                                  res.max_iteration_count))
+            key = _point_key(spec, cfg)
+            rec = journal.get(key) if (journal is not None and resume) \
+                else None
+            if rec is not None:
+                noshare = [_intkeys(d) for d in rec["noshare"]]
+                share = [{int(r): _intkeys(h) for r, h in d.items()}
+                         for d in rec["share"]]
+                refs = rec["refs"]
+                degradations = ("journal",) + tuple(rec.get(
+                    "degradations", ()))
+            else:
+                res = run_resilient(spec, cfg, share_cap)
+                noshare, share = res.noshare_list(), res.share_list()
+                refs = res.max_iteration_count
+                degradations = tuple(res.degradations)
+                if journal is not None:
+                    journal.record(key, noshare=noshare, share=share,
+                                   refs=refs,
+                                   degradations=list(degradations))
+            ri = cri.distribute(noshare, share, t)
+            out.append(SweepPoint(cfg, mrc.aet_mrc(ri, cfg), refs,
+                                  degradations))
     return out
 
 
 def table(points: Iterable[SweepPoint], cache_lines: Sequence[int]) -> str:
     """Plain-text comparison table: one row per config, one column per cache
-    size (in lines), values = predicted miss ratio."""
+    size (in lines), values = predicted miss ratio.  A ``degraded`` column
+    appears only when some point actually degraded (or resumed), so the
+    clean-run format stays byte-stable for diffing."""
+    points = list(points)
+    with_deg = any(p.degradations for p in points)
     heads = ["threads", "chunk"] + [f"mr@{c}" for c in cache_lines]
+    if with_deg:
+        heads.append("degraded")
     rows = [heads]
     for p in points:
-        rows.append(
-            [str(p.cfg.thread_num), str(p.cfg.chunk_size)]
+        row = [str(p.cfg.thread_num), str(p.cfg.chunk_size)] \
             + [f"{p.miss_ratio_at(c):.4f}" for c in cache_lines]
-        )
+        if with_deg:
+            row.append(",".join(p.degradations) or "-")
+        rows.append(row)
     widths = [max(len(r[i]) for r in rows) for i in range(len(heads))]
     return "\n".join(
         "  ".join(v.rjust(w) for v, w in zip(r, widths)) for r in rows
     )
+
+
+def carried_levels(spec: LoopNestSpec) -> str:
+    """The static analyzer's PL303 carried-level classifications as a
+    compact report block (ROADMAP PR-1 follow-up): one line per annotated
+    reference, naming the loop level that carries its reuse — the same
+    quantity the dynamic share split measures, so the sweep report shows
+    the analytic prediction next to the sampled numbers.
+
+    Built from the analyzer's own PL303 diagnostics (not a re-derivation)
+    so this report can never drift from what ``pluss lint`` prints."""
+    from pluss.analysis import deps
+
+    lines = [
+        f"  {d.ref} [{d.array}] {d.path}: {d.message}"
+        for d in deps.check(spec) if d.code == "PL303"
+    ]
+    if not lines:
+        return ""
+    return "carried levels (PL303):\n" + "\n".join(lines)

@@ -69,6 +69,9 @@ class ReplayResult:
     hist: np.ndarray          # [NBINS] int64
     total_count: int
     n_lines: int
+    #: degradation-ladder rungs taken (pluss.resilience) — empty for a
+    #: clean first-attempt replay
+    degradations: tuple = ()
 
     def histogram(self) -> dict:
         out = {-1: float(self.hist[0])}
@@ -304,6 +307,23 @@ class _Compactor:
         self.next_free = 0
         self._native = None  # lazy: pluss.native.line_mapper()
 
+    def snapshot(self) -> dict:
+        """JSON-able state for checkpoint/resume: the whole id assignment
+        is these few arrays (dozens of clusters), so a resumed stream maps
+        every line to the identical dense id."""
+        return {"slack": self.slack, "starts": self.starts.tolist(),
+                "widths": self.widths.tolist(), "bases": self.bases.tolist(),
+                "next_free": int(self.next_free)}
+
+    @classmethod
+    def restore(cls, snap: dict) -> "_Compactor":
+        comp = cls(slack=int(snap["slack"]))
+        comp.starts = np.asarray(snap["starts"], np.int64)
+        comp.widths = np.asarray(snap["widths"], np.int64)
+        comp.bases = np.asarray(snap["bases"], np.int64)
+        comp.next_free = int(snap["next_free"])
+        return comp
+
     def map_raw(self, raw: np.ndarray, shift: int) -> np.ndarray | None:
         """Fused native fast path: u64 byte addresses -> int32 ids in one
         C pass, valid only while the table holds a single cluster that
@@ -399,12 +419,85 @@ def _replay_ids(ids: np.ndarray, n_lines: int, n: int,
     return ReplayResult(np.asarray(hist, np.int64), n, n_lines)
 
 
+def _trace_fingerprint(path: str) -> str:
+    """Cheap content identity of a trace file: sha256 of the first 1 MB.
+
+    The checkpoint identity must bind the FILE, not just its shape — a
+    regenerated trace with the same record count (bench generators use a
+    fixed n_refs) would otherwise accept a stale checkpoint and splice a
+    different trace's carries into the replay."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        h.update(f.read(1 << 20))
+    return h.hexdigest()[:16]
+
+
+def _ckpt_save(path: str, b_next: int, n: int, window: int, cls: int,
+               precompacted: bool, fp: str, last_pos, hist,
+               comp_snap: dict) -> None:
+    """Atomic replay checkpoint: everything a resumed run needs to continue
+    bit-identically (device carries + compactor id table + position), plus
+    the FULL run identity — (n, window, cls, precompacted) all change the
+    compaction/scan semantics and ``fp`` binds the source file's content,
+    so a mismatch on any of them must start fresh, never splice."""
+    import json
+    import os
+
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
+    np.savez(tmp,
+             last_pos=np.asarray(last_pos), hist=np.asarray(hist),
+             b_next=np.int64(b_next), n=np.int64(n),
+             window=np.int64(window), cls=np.int64(cls),
+             precompacted=np.int64(bool(precompacted)),
+             fp=np.frombuffer(fp.encode(), np.uint8),
+             comp=np.frombuffer(json.dumps(comp_snap).encode(), np.uint8))
+    os.replace(tmp, path)
+
+
+def _ckpt_load(path: str, n: int, window: int, cls: int,
+               precompacted: bool, fp: str):
+    """(b_next, last_pos, hist, comp) from a checkpoint, or None when the
+    checkpoint is absent or describes a different run identity."""
+    import json
+    import os
+    import sys
+
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            ident = (int(z["n"]), int(z["window"]), int(z["cls"]),
+                     int(z["precompacted"]), bytes(z["fp"]).decode())
+            if ident != (n, window, cls, int(bool(precompacted)), fp):
+                print(f"trace: checkpoint {path} is for a different run "
+                      f"(n, window, cls, precompacted, file)={ident}; "
+                      "starting fresh", file=sys.stderr)
+                return None
+            comp = _Compactor.restore(
+                json.loads(bytes(z["comp"]).decode()))
+            return int(z["b_next"]), z["last_pos"], z["hist"], comp
+    except Exception as e:
+        # same policy as the plan cache: quarantine the bad bytes and
+        # start fresh — the source trace is intact, so a corrupt
+        # checkpoint costs a recompute, never the run
+        from pluss.resilience.errors import quarantine_artifact
+
+        quarantine_artifact(path, "trace replay-checkpoint", e,
+                            action="starting fresh")
+        return None
+
+
 def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                 window: int = TRACE_WINDOW, precompacted: bool = False,
                 initial_capacity: int = 1 << 20,
                 limit_refs: int | None = None,
                 pipeline: bool = True,
-                deadline_s: float | None = None) -> ReplayResult:
+                deadline_s: float | None = None,
+                checkpoint_path: str | None = None,
+                checkpoint_every: int = 16,
+                resume: bool = False) -> ReplayResult:
     """Replay a trace FILE in bounded host memory (BASELINE config 5 scale).
 
     Unlike ``replay(load_trace(path))``, which slurps the whole file, this
@@ -420,15 +513,22 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     replayed (``total_count`` reflects the truncation).  A pre-run
     projection cannot defend against the tunneled feed SLOWING mid-run
     (observed: a run projected fine at ~23 MB/s finished at ~5 MB/s).
+
+    ``checkpoint_path`` + ``resume``: crash recovery for multi-minute
+    replays.  Every ``checkpoint_every`` batches the device carries
+    (``last_pos``, ``hist``), the compactor's id table, and the stream
+    position are written atomically; ``resume=True`` continues from the
+    checkpoint instead of batch 0 — bit-identical to an uninterrupted run,
+    recomputing only the batches after the last checkpoint (``pluss trace
+    --resume``).  A checkpoint for a different (refs, window) shape is
+    ignored with a notice, never silently mixed in.
     """
     if fmt == "text":  # line-oriented; no random access worth streaming
         return replay(load_trace(path, fmt), cls, window,
                       precompacted=precompacted)
     if fmt != "u64":
         raise ValueError(f"unknown trace format {fmt!r}")
-    import os
-
-    n = os.path.getsize(path) // 8
+    n = _u64_count(path)
     if limit_refs is not None:
         n = min(n, limit_refs)  # prefix replay (e.g. compile warmup)
     if n == 0:
@@ -446,14 +546,37 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     fn = _replay_fn(window, pos_dtype)
     pdt = np.dtype(pos_dtype)
 
+    b0 = 0
+    comp0 = _Compactor()
+    fp = _trace_fingerprint(path) if checkpoint_path else ""
+    ck = _ckpt_load(checkpoint_path, n, window, cls, precompacted, fp) \
+        if resume and checkpoint_path else None
+    if ck is not None:
+        b0, ck_last_pos, ck_hist, comp0 = ck
+        import sys
+
+        print(f"trace: resuming from checkpoint at batch {b0}/{n_batches} "
+              f"({min(n, b0 * batch)} refs already replayed)",
+              file=sys.stderr)
+    if b0 >= n_batches:   # checkpoint already covers the whole stream
+        return ReplayResult(np.asarray(ck_hist, np.int64), n,
+                            comp0.next_free)
+
     def batches():
-        """(padded ids, table size) per disk batch, in stream order (the
-        compactor is stateful).  Ids ship 24-bit packed (u8 [n, 3]) while
-        the table fits — the h2d feed, not device compute, bounds this
-        path end-to-end (see _pack24)."""
-        comp = _Compactor()
+        """(padded ids, table size, compactor snapshot) per disk batch, in
+        stream order (the compactor is stateful).  Ids ship 24-bit packed
+        (u8 [n, 3]) while the table fits — the h2d feed, not device
+        compute, bounds this path end-to-end (see _pack24).  The snapshot
+        rides WITH the batch so the checkpointing consumer records state
+        consistent with what it has actually dispatched, even while the
+        producer thread runs ahead."""
+        from pluss.resilience import faults
+
+        comp = comp0
         with open(path, "rb") as f:
-            for b in range(n_batches):
+            f.seek(b0 * batch * 8)
+            for b in range(b0, n_batches):
+                faults.check("trace.read_batch")  # chaos injection site
                 # never read past n: a limit_refs prefix must not compact
                 # (or grow the device table with) addresses it will mask
                 # out anyway
@@ -467,7 +590,8 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                 pad = batch - len(ids)
                 if pad:
                     ids = np.concatenate([ids, np.zeros(pad, np.int32)])
-                yield _pack_ids(ids, comp.next_free), comp.next_free
+                snap = comp.snapshot() if checkpoint_path else None
+                yield _pack_ids(ids, comp.next_free), comp.next_free, snap
 
     # pipelined host side: a reader thread streams disk batches through the
     # (stateful, hence single-threaded) compactor while the main thread
@@ -484,13 +608,20 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     import time as _time
 
     t0 = _time.perf_counter()
-    capacity = initial_capacity
-    last_pos = jnp.full((capacity,), -1, pdt)
-    hist = jnp.zeros((NBINS,), pdt)
-    n_lines = 0
-    done = 0
+    if ck is not None:
+        capacity = len(ck_last_pos)
+        last_pos = jnp.asarray(ck_last_pos.astype(pdt))
+        hist = jnp.asarray(ck_hist.astype(pdt))
+        n_lines = comp0.next_free
+        done = min(n, b0 * batch)
+    else:
+        capacity = initial_capacity
+        last_pos = jnp.full((capacity,), -1, pdt)
+        hist = jnp.zeros((NBINS,), pdt)
+        n_lines = 0
+        done = 0
     with src as it:
-        for b, (ids, n_lines) in enumerate(it):
+        for b, (ids, n_lines, snap) in enumerate(it, start=b0):
             if n_lines > capacity:
                 while capacity < n_lines:
                     capacity *= 2
@@ -505,6 +636,12 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                 pdt.type(n),
             )
             done = min(n, (b + 1) * batch)
+            if checkpoint_path and done < n \
+                    and (b + 1 - b0) % checkpoint_every == 0:
+                # the d2h fetch synchronizes the dispatch queue — that is
+                # the price of a durable point; checkpoint_every amortizes
+                _ckpt_save(checkpoint_path, b + 1, n, window, cls,
+                           precompacted, fp, last_pos, hist, snap)
             # the cheap unsynced clock runs every batch; the device sync
             # (which is what makes the elapsed time REAL under async
             # dispatch) is only paid once the unsynced time is already
@@ -517,12 +654,22 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                     # truncation is clean at a batch boundary: every
                     # processed position is < done, none beyond dispatched
                     break
+    if checkpoint_path and done >= n:
+        import os
+
+        # a finished run retires its checkpoint: a later DIFFERENT run
+        # must not resume from this one's final state
+        try:
+            os.unlink(checkpoint_path)
+        except OSError:
+            pass
     return ReplayResult(np.asarray(hist, np.int64), done, n_lines)
 
 
 def pack_file(path: str, out_path: str, cls: int = 64,
               window: int = TRACE_WINDOW, precompacted: bool = False,
-              limit_refs: int | None = None) -> dict:
+              limit_refs: int | None = None,
+              resume: bool = False) -> dict:
     """Compact + pack a raw u64 trace ONCE, writing the replay wire format.
 
     Streams the trace through the same incremental compactor as
@@ -532,11 +679,20 @@ def pack_file(path: str, out_path: str, cls: int = 64,
     compaction of a 1e9-ref trace costs minutes on this box's single core;
     paying it once lets :func:`replay_resident` stage straight from disk on
     every later run.  Returns the sidecar dict.
+
+    Progress journals to ``out_path + '.journal'`` per flushed batch (the
+    output offset + the compactor's id table); ``resume=True`` after a
+    crash truncates the partial ``.tmp`` to the last journaled batch
+    boundary and continues — byte-identical to an uninterrupted pack, with
+    zero batches recompacted before the checkpoint.
     """
     import json
     import os
 
-    n = os.path.getsize(path) // 8
+    from pluss.resilience import faults
+    from pluss.resilience.journal import Journal
+
+    n = _u64_count(path)
     if limit_refs is not None:
         n = min(n, limit_refs)
     if cls & (cls - 1):
@@ -546,8 +702,56 @@ def pack_file(path: str, out_path: str, cls: int = 64,
     n_batches = -(-n // batch)
     comp = _Compactor()
     tmp = out_path + ".tmp"
-    with open(path, "rb") as f, open(tmp, "wb") as out:
+    jpath = out_path + ".journal"
+    b0 = 0
+    fp = _trace_fingerprint(path)
+    if resume and os.path.exists(jpath) and os.path.exists(tmp):
+        jr = Journal(jpath)
+        best = None
+        ident = {"n": n, "window": window, "cls": cls,
+                 "precompacted": bool(precompacted), "fp": fp}
         for b in range(n_batches):
+            rec = jr.get({"batch": b})
+            if rec is None:
+                break
+            if any(rec.get(k) != v for k, v in ident.items()):
+                best = None   # journal from a different pack run
+                break
+            best = rec
+        if best is not None and os.path.getsize(tmp) < best["out_bytes"]:
+            # the journal line outlived the data it describes (e.g. a
+            # power loss between data flush and durability): truncating
+            # FORWARD would zero-extend the stream — walk back to the
+            # last batch whose bytes are actually on disk
+            size = os.path.getsize(tmp)
+            while best is not None and best["out_bytes"] > size:
+                b_prev = best["key"]["batch"] - 1
+                best = jr.get({"batch": b_prev}) if b_prev >= 0 else None
+        if best is not None:
+            b0 = best["key"]["batch"] + 1
+            comp = _Compactor.restore(best["comp"])
+            with open(tmp, "r+b") as out:
+                out.truncate(best["out_bytes"])
+            import sys
+
+            print(f"trace: resuming pack at batch {b0}/{n_batches} "
+                  f"({best['out_bytes']} bytes already packed)",
+                  file=sys.stderr)
+    if b0 == 0:
+        # fresh start: a STALE journal from an earlier crashed pack must
+        # not survive — a later resume's contiguity scan would splice its
+        # leftover high-batch records onto the new run's prefix and
+        # truncate() past EOF (zero-extending a corrupt output)
+        try:
+            os.unlink(jpath)
+        except OSError:
+            pass
+    journal = Journal(jpath)
+    with open(path, "rb") as f, open(tmp, "r+b" if b0 else "wb") as out:
+        f.seek(b0 * batch * 8)
+        out.seek(0, os.SEEK_END)
+        for b in range(b0, n_batches):
+            faults.check("trace.read_batch")  # chaos injection site
             raw = np.fromfile(f, dtype="<u8", count=min(batch, n - b * batch))
             ids = comp.map_raw(raw, 0 if precompacted else shift)
             if ids is None:
@@ -564,10 +768,23 @@ def pack_file(path: str, out_path: str, cls: int = 64,
                     "resident staging needs the int32 fallback (unbuilt: "
                     "no workload here needs it)")
             _pack24(ids).tofile(out)
+            out.flush()
+            # the DATA must be durable before the journal line that
+            # promises it exists — otherwise a power loss can leave a
+            # journal entry pointing past the real end of the file
+            os.fsync(out.fileno())
+            journal.record({"batch": b}, out_bytes=out.tell(),
+                           comp=comp.snapshot(), n=n, window=window,
+                           cls=cls, precompacted=bool(precompacted),
+                           fp=fp)
     os.replace(tmp, out_path)
     meta = {"n": n, "n_lines": comp.next_free, "fmt": "u24"}
     with open(out_path + ".json", "w") as f:
         json.dump(meta, f)
+    try:
+        os.unlink(jpath)   # the pack is durable; the journal is spent
+    except OSError:
+        pass
     return meta
 
 
@@ -817,8 +1034,10 @@ def shard_replay(addrs: np.ndarray, cls: int = 64, mesh=None,
             cold.sum().astype(pdt))
         return jax.lax.psum(hist, "d")
 
-    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("d"),
-                              out_specs=P()))
+    from pluss.utils import compat
+
+    f = jax.jit(compat.shard_map(body, mesh=mesh, in_specs=P("d"),
+                                 out_specs=P()))
     hist = f(ids3)
     return ReplayResult(np.asarray(hist, np.int64), n, n_lines)
 
@@ -853,6 +1072,7 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from pluss.parallel.shard import _capture_heads, _vary, default_mesh
+    from pluss.utils import compat
 
     mesh = mesh or default_mesh()
     D = mesh.devices.size
@@ -861,7 +1081,7 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
             "shard_replay_file needs precompacted ids under multi-process "
             "execution (per-process cluster discovery would diverge)"
         )
-    n = os.path.getsize(path) // 8
+    n = _u64_count(path)
     if n == 0:
         return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)
     if cls & (cls - 1):
@@ -941,9 +1161,9 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
             return (last_pos[None], hist[None], head_pos[None])
 
         fn = jax.jit(
-            jax.shard_map(body, mesh=mesh,
-                          in_specs=(P(), P("d"), P("d"), P("d"), P("d")),
-                          out_specs=(P("d"), P("d"), P("d"))),
+            compat.shard_map(body, mesh=mesh,
+                             in_specs=(P(), P("d"), P("d"), P("d"), P("d")),
+                             out_specs=(P("d"), P("d"), P("d"))),
             donate_argnums=donate,
         )
         step_cache[L] = fn
@@ -966,7 +1186,7 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
                 cold.sum().astype(pdt))
             return jax.lax.psum(hist, "d")
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(compat.shard_map(
             body, mesh=mesh, in_specs=(P("d"), P("d"), P("d")),
             out_specs=P()))
 
@@ -1006,16 +1226,56 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
     return ReplayResult(np.asarray(out, np.int64), n, comp.next_free)
 
 
+def _u64_count(path: str) -> int:
+    """Record count of a packed-u64 trace, REJECTING truncated files.
+
+    A byte length that is not a multiple of 8 means the capture (or a
+    copy) was cut mid-record; silently flooring the count would misparse
+    every later analysis, so it is a classified
+    :class:`~pluss.resilience.errors.DataLoss` naming the exact offset.
+    """
+    import os
+
+    from pluss.resilience.errors import DataLoss
+
+    size = os.path.getsize(path)
+    if size % 8:
+        raise DataLoss(
+            f"truncated u64 trace {path}: {size} bytes is not a multiple "
+            f"of 8 ({size % 8} trailing bytes after the last whole record "
+            f"at byte offset {size - size % 8})", site="trace.load")
+    return size // 8
+
+
 def load_trace(path: str, fmt: str = "u64") -> np.ndarray:
     """Load a trace file.
 
     ``fmt``: ``u64`` — packed little-endian uint64 byte addresses (the shape
     DynamoRIO's memtrace samples reduce to); ``text`` — one address per line,
     decimal or 0x-hex.
+
+    Malformed input is a classified :class:`DataLoss` naming the byte
+    offset (u64: length not a multiple of 8) or line number (text: a line
+    that parses as neither decimal nor 0x-hex) — never a silent misparse.
     """
     if fmt == "u64":
+        _u64_count(path)
         return np.fromfile(path, dtype="<u8").astype(np.int64)
     if fmt == "text":
+        from pluss.resilience.errors import DataLoss
+
+        out = []
         with open(path) as f:
-            return np.asarray([int(s, 0) for s in f if s.strip()], np.int64)
+            for lineno, s in enumerate(f, 1):
+                s = s.strip()
+                if not s:
+                    continue
+                try:
+                    out.append(int(s, 0))
+                except ValueError:
+                    raise DataLoss(
+                        f"garbage text-trace line {lineno} of {path}: "
+                        f"{s[:40]!r} is neither decimal nor 0x-hex",
+                        site="trace.load") from None
+        return np.asarray(out, np.int64)
     raise ValueError(f"unknown trace format {fmt!r}")

@@ -16,6 +16,16 @@ CLI_FLAGS=${PLUSS_CLI_FLAGS---cpu}
 # Diagnostics go to stderr so output.txt keeps only the diffable blocks.
 python -m pluss.cli lint --all 1>&2
 
+# opt-in chaos smoke (PLUSS_CHAOS=1): a short seeded fault-plan soak on the
+# CPU backend — every injected fault (OOM / compile / share-cap / corrupt
+# cache) must either recover to a bit-exact result via the degradation
+# ladder or fail with a classified PlussError.  Seed via PLUSS_CHAOS_SEED
+# for a reproducible plan; rounds via PLUSS_CHAOS_ROUNDS.
+if [ "${PLUSS_CHAOS:-0}" = 1 ]; then
+  python soak.py --chaos "${PLUSS_CHAOS_ROUNDS:-3}" \
+    "${PLUSS_CHAOS_SEED:-20260804}" 1>&2
+fi
+
 # always try make (incremental, no-op when fresh): a stale prebuilt binary
 # would mis-parse the --spec flag used for non-gemm models.  A failed build
 # only warns — the Python CLI block below must still run and diagnose.

@@ -1,21 +1,91 @@
-"""Seeded hypothesis soak over the property generators (run per round).
+"""Seeded soaks: hypothesis property soak + chaos (fault-plan) soak.
 
-Re-wraps tests/test_property.py's differential properties with a larger
-example budget and a fresh seed.  Not part of the suite; run manually:
-``python soak.py [examples] [seed]``.
+Property soak (default): re-wraps tests/test_property.py's differential
+properties with a larger example budget and a fresh seed.  Not part of the
+suite; run manually: ``python soak.py [examples] [seed]``.
+
+Chaos soak (``python soak.py --chaos N [seed]``): N rounds, each running
+the resilient executor under a fresh seeded random fault plan
+(:meth:`pluss.resilience.FaultPlan.random` — injected OOMs, compile
+failures, share-cap overflows, corrupt plan-cache entries) on a workload
+drawn from a small pool.  Every round must either recover to a result
+BIT-IDENTICAL to the clean run or fail with a classified ``PlussError``
+— a raw XLA/OS exception escaping is a soak failure.  The seed is printed
+so any failure replays exactly.  Needs no hypothesis install (run.sh's
+opt-in chaos smoke uses it on bare images).
 """
 
 import sys
 import time
 
-from hypothesis import HealthCheck, given, seed, settings, strategies as st
 
-sys.path.insert(0, ".")
-import tests.conftest  # noqa: F401  (CPU mesh + x64 + no plan cache)
-import tests.test_property as tp
+def chaos(n_rounds: int, sd: int) -> int:
+    import os
+    import random
+    import tempfile
+
+    # self-contained env setup (NOT tests.conftest: that module imports
+    # pytest, which bare images don't ship, and pays the shard-backend
+    # probe — pure waste for a single-process CPU soak).  The plan cache
+    # points at a throwaway dir and stays ENABLED: disabling it would
+    # turn every injected corrupt_cache fault into a no-op and the soak's
+    # quarantine coverage into a lie.
+    os.environ.pop("PLUSS_NO_PLAN_CACHE", None)
+    os.environ["PLUSS_PLAN_CACHE_DIR"] = tempfile.mkdtemp(
+        prefix="pluss_chaos_cache_")
+    from pluss.utils.platform import enable_x64, force_cpu
+
+    force_cpu()
+    enable_x64()
+    from pluss import engine
+    from pluss.config import SamplerConfig
+    from pluss.models import REGISTRY
+    from pluss.resilience import FaultPlan, PlussError, run_resilient
+    from pluss.resilience import faults
+
+    pool = [("gemm", 16, SamplerConfig(cls=8)),
+            ("syrk", 12, SamplerConfig(cls=8)),
+            ("mvt", 16, SamplerConfig()),
+            ("gemm", 13, SamplerConfig(thread_num=2, chunk_size=3))]
+    rng = random.Random(sd)
+    failures = 0
+    for i in range(n_rounds):
+        name, n, cfg = rng.choice(pool)
+        plan = FaultPlan.random(sd + i, n_faults=rng.randint(1, 3))
+        spec = REGISTRY[name](n)
+        clean = engine.run(spec, cfg)
+        faults.install(plan)
+        t0 = time.perf_counter()
+        res = None
+        try:
+            res = run_resilient(spec, cfg)
+            ok = (res.noshare_dense.tolist() == clean.noshare_dense.tolist()
+                  and res.share_raw == clean.share_raw)
+            status = "bit-exact" if ok else "MISMATCH"
+            if not ok:
+                failures += 1
+        except PlussError as e:
+            # a classified failure is an acceptable outcome (e.g. a plan
+            # whose faults outnumber the retry budget); a RAW exception
+            # below is not
+            status = f"classified {type(e).__name__}"
+        except BaseException as e:  # noqa: BLE001 — this IS the assertion
+            status = f"RAW ESCAPE {type(e).__name__}: {e}"
+            failures += 1
+        finally:
+            faults.install(None)
+        deg = ",".join(res.degradations) if res is not None else ""
+        print(f"chaos[{i}] {name}{n} plan={plan.describe()}: {status}"
+              + (f" (degraded: {deg})" if deg else "")
+              + f" in {time.perf_counter() - t0:.1f}s", flush=True)
+    print(f"chaos soak: {n_rounds} rounds, {failures} failure(s), seed {sd}",
+          flush=True)
+    return 1 if failures else 0
 
 
 def soak(name, inner, budget, sd, **strats):
+    from hypothesis import HealthCheck, given, seed, settings
+
     t0 = time.perf_counter()
     fn = seed(sd)(settings(
         max_examples=budget, deadline=None,
@@ -27,6 +97,18 @@ def soak(name, inner, budget, sd, **strats):
 
 
 def main():
+    sys.path.insert(0, ".")
+    if len(sys.argv) > 1 and sys.argv[1] == "--chaos":
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+        sd = int(sys.argv[3]) if len(sys.argv) > 3 else int(time.time())
+        print(f"chaos soak seed {sd}", flush=True)
+        sys.exit(chaos(n, sd))
+
+    from hypothesis import strategies as st
+
+    import tests.conftest  # noqa: F401  (CPU mesh + x64 + no plan cache)
+    import tests.test_property as tp
+
     budget = int(sys.argv[1]) if len(sys.argv) > 1 else 150
     sd = int(sys.argv[2]) if len(sys.argv) > 2 else int(time.time())
     print(f"soak seed {sd}", flush=True)

@@ -77,6 +77,14 @@ def test_two_process_shard_run_matches_engine(tmp_path):
             ))
         for p, lg in zip(procs, logs):
             p.wait(timeout=600)
+            if p.returncode != 0 and "Multiprocess computations aren't " \
+                    "implemented on the CPU backend" in lg.read_text():
+                # environment guard: some jax versions cannot EXECUTE
+                # multi-process SPMD on CPU at all (bring-up still works —
+                # tests/test_resilience.py covers that path); skip with
+                # the reason instead of failing on a missing capability
+                pytest.skip("multi-process CPU execution unsupported by "
+                            "this jax build")
             assert p.returncode == 0, \
                 f"worker failed:\n{lg.read_text()[-2000:]}"
     finally:

@@ -1,0 +1,702 @@
+"""Chaos suite: injected faults must recover bit-exact or fail classified.
+
+The acceptance contract of the resilience layer (ISSUE 2): under injected
+OOM / corrupt-cache / truncated-trace / killed-worker faults, runs either
+recover to results BIT-IDENTICAL to a clean run (the degradation ladder's
+rungs are all result-invariant knobs) or fail with a classified
+``PlussError`` naming the site — no raw XLA/OS exception escapes a
+resilient entry point, and an interrupted ``sweep --resume`` recomputes
+zero finished points.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pluss import engine, trace
+from pluss.config import SamplerConfig
+from pluss.models import gemm
+from pluss.resilience import (
+    CacheCorrupt,
+    CollectiveError,
+    CompileError,
+    DataLoss,
+    FaultPlan,
+    Journal,
+    PlussError,
+    ResourceExhausted,
+    ShareCapOverflow,
+    classify,
+    run_resilient,
+    replay_file_resilient,
+)
+from pluss.resilience import faults
+from pluss.resilience.ladder import LADDER, Retry
+
+CFG = SamplerConfig(cls=8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    """No test may leak an installed fault plan into the next."""
+    faults.install(None)
+    yield
+    faults.install(None)
+
+
+@pytest.fixture()
+def fast_retry():
+    return Retry(backoff_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy
+
+
+def test_classify_oom_markers():
+    for msg in ("RESOURCE_EXHAUSTED: Out of memory allocating 2.5G",
+                "XlaRuntimeError: RESOURCE_EXHAUSTED while running"):
+        e = classify(RuntimeError(msg), site="engine.run")
+        assert isinstance(e, ResourceExhausted)
+        assert e.degradable and not e.retryable and not e.fatal
+        assert e.site == "engine.run"
+        assert e.__cause__ is e.cause
+
+
+def test_classify_engine_budget_guard_is_degradable():
+    # the plan-time sort-budget guard IS an OOM prediction — same rung
+    e = classify(RuntimeError(
+        "nest 0: the sort window stream needs ~12.00 GiB ... beyond the "
+        "8.00 GiB device budget."))
+    assert isinstance(e, ResourceExhausted)
+
+
+def test_classify_share_cap_carries_needed():
+    e = classify(engine.ShareCapExceeded(4096, 1024))
+    assert isinstance(e, ShareCapOverflow)
+    assert e.retryable and e.needed == 4096
+
+
+def test_classify_compile_collective_memory_unknown():
+    assert isinstance(classify(RuntimeError("XLA compilation failed")),
+                      CompileError)
+    assert isinstance(classify(ConnectionError("refused")), CollectiveError)
+    assert isinstance(classify(MemoryError()), ResourceExhausted)
+    unk = classify(ValueError("no marker at all"), site="s")
+    assert type(unk) is PlussError and unk.fatal
+
+
+def test_classify_idempotent_on_pluss_errors():
+    e = DataLoss("gone", site="trace.load")
+    assert classify(e) is e
+    assert isinstance(CacheCorrupt("x"), PlussError)
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+
+
+def test_fault_plan_grammar():
+    plan = FaultPlan.parse("oom, oom@2 ,corrupt_cache,kill_worker@1")
+    assert plan.describe() == "oom@1,oom@2,corrupt_cache@1,kill_worker@1"
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse("frobnicate")
+    with pytest.raises(ValueError, match="occurrence"):
+        FaultPlan.parse("oom@x")
+
+
+def test_fault_plan_random_is_seed_deterministic():
+    a, b = FaultPlan.random(7, 3), FaultPlan.random(7, 3)
+    assert a.describe() == b.describe()
+    assert a.describe() != FaultPlan.random(8, 3).describe()
+
+
+def test_fault_fires_at_exact_occurrence():
+    plan = FaultPlan.parse("oom@2")
+    faults.install(plan)
+    faults.check("engine.run")            # hit 1: clean
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        faults.check("engine.run")        # hit 2: armed
+    faults.check("engine.run")            # hit 3: entry spent
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+
+
+def test_injected_oom_walks_ladder_bit_exact(fast_retry):
+    clean = engine.run(gemm(16), CFG)
+    faults.install(FaultPlan.parse("oom,oom@2"))
+    res = run_resilient(gemm(16), CFG, retry=fast_retry)
+    assert res.degradations == ("shrink_window", "raise_n_windows")
+    assert res.noshare_dense.tolist() == clean.noshare_dense.tolist()
+    assert res.share_raw == clean.share_raw
+    assert res.max_iteration_count == clean.max_iteration_count
+
+
+def test_injected_oom_reaches_sliced_pipeline_bit_exact(fast_retry):
+    clean = engine.run(gemm(16), CFG)
+    faults.install(FaultPlan.parse("oom,oom@2,oom@3"))
+    res = run_resilient(gemm(16), CFG, retry=fast_retry)
+    assert res.degradations == LADDER[:3]
+    assert res.noshare_dense.tolist() == clean.noshare_dense.tolist()
+    assert res.share_raw == clean.share_raw
+
+
+def test_shard_backend_ladder_degrades_to_single_device(fast_retry):
+    from tests.conftest import require_shard_backend
+
+    require_shard_backend()
+    clean = engine.run(gemm(16), CFG)
+    # two shard-entry OOMs walk shrink_window then single_device (the
+    # windowed engine is the same computation — backend equivalence)
+    faults.install(FaultPlan.parse("shard_oom,shard_oom@2"))
+    res = run_resilient(gemm(16), CFG, backend="shard", retry=fast_retry)
+    assert res.degradations == ("shrink_window", "single_device")
+    assert res.noshare_dense.tolist() == clean.noshare_dense.tolist()
+    assert res.share_raw == clean.share_raw
+
+
+def test_injected_compile_failure_degrades(fast_retry):
+    clean = engine.run(gemm(16), CFG)
+    faults.install(FaultPlan.parse("compile"))
+    res = run_resilient(gemm(16), CFG, retry=fast_retry)
+    assert res.degradations == ("shrink_window",)
+    assert res.noshare_dense.tolist() == clean.noshare_dense.tolist()
+
+
+def test_share_cap_injection_folds_into_auto_retry(fast_retry, capsys):
+    # injected at engine.finalize: the engine's own auto-retry machinery
+    # absorbs it (no ladder rung consumed), result identical
+    clean = engine.run(gemm(16), CFG)
+    faults.install(FaultPlan.parse("share_cap"))
+    res = run_resilient(gemm(16), CFG, retry=fast_retry)
+    assert res.degradations == ()
+    assert res.noshare_dense.tolist() == clean.noshare_dense.tolist()
+    assert res.share_raw == clean.share_raw
+
+
+def test_exhausted_ladder_raises_classified_not_raw(fast_retry):
+    # more OOMs than rungs: the final failure must surface AS the taxonomy
+    faults.install(FaultPlan.parse("oom,oom@2,oom@3,oom@4,oom@5"))
+    with pytest.raises(ResourceExhausted):
+        run_resilient(gemm(16), CFG, retry=fast_retry)
+
+
+def test_plain_engine_run_still_raises_raw():
+    # the UNwrapped entry point keeps raw semantics — resilience is the
+    # executor's job, not a silent behavior change under everyone
+    faults.install(FaultPlan.parse("oom"))
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        engine.run(gemm(16), CFG)
+
+
+def test_describe_path_carries_degradation_stamp():
+    label = engine.describe_path(gemm(16), CFG,
+                                 degradations=("shrink_window",
+                                               "cpu_fallback"))
+    assert label.endswith("[degraded: shrink_window,cpu_fallback]")
+    assert engine.describe_path(gemm(16), CFG) == label.split(" [")[0]
+
+
+# ---------------------------------------------------------------------------
+# plan cache quarantine
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("PLUSS_NO_PLAN_CACHE", raising=False)
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_DIR", str(tmp_path))
+    engine.compiled.cache_clear()
+    yield tmp_path
+    engine.compiled.cache_clear()
+
+
+def test_corrupt_plan_cache_entry_quarantined(cache_dir, capsys):
+    clean = engine.run(gemm(16), CFG)
+    entries = [f for f in os.listdir(cache_dir) if f.endswith(".pkl")]
+    assert entries, "plan cache should have been populated"
+    path = cache_dir / entries[0]
+    with open(path, "r+b") as f:
+        f.write(b"\x00GARBAGE")
+    engine.compiled.cache_clear()
+    res = engine.run(gemm(16), CFG)
+    assert res.noshare_dense.tolist() == clean.noshare_dense.tolist()
+    corrupt = [f for f in os.listdir(cache_dir) if f.endswith(".corrupt")]
+    assert corrupt == [entries[0] + ".corrupt"]
+    # the rebuilt artifact landed back in the now-free slot
+    assert entries[0] in os.listdir(cache_dir)
+    assert "quarantined" in capsys.readouterr().err
+
+
+def test_fault_injected_cache_corruption_recovers(cache_dir):
+    clean = engine.run(gemm(16), CFG)
+    engine.compiled.cache_clear()
+    faults.install(FaultPlan.parse("corrupt_cache"))
+    res = engine.run(gemm(16), CFG)
+    assert res.noshare_dense.tolist() == clean.noshare_dense.tolist()
+    assert any(f.endswith(".corrupt") for f in os.listdir(cache_dir))
+
+
+def test_plan_cache_tmp_names_are_unique():
+    import re
+
+    src = open(os.path.join(os.path.dirname(engine.__file__),
+                            "engine.py")).read()
+    # the tmp slot must be unique beyond the pid (threads share a pid)
+    assert re.search(r"\.tmp\.\{os\.getpid\(\)\}\.\{uuid", src)
+
+
+# ---------------------------------------------------------------------------
+# trace I/O hardening + checkpointed staging/replay
+
+
+def test_truncated_u64_trace_rejected(tmp_path):
+    p = tmp_path / "bad.bin"
+    p.write_bytes(b"\x01" * 17)
+    with pytest.raises(DataLoss, match=r"17 bytes.*offset 16"):
+        trace.load_trace(str(p))
+    with pytest.raises(DataLoss):
+        trace.replay_file(str(p))
+    with pytest.raises(DataLoss):
+        trace.pack_file(str(p), str(tmp_path / "out.pack"))
+
+
+def test_garbage_text_trace_line_rejected(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("0x40\n128\nnot hex\n")
+    with pytest.raises(DataLoss, match="line 3"):
+        trace.load_trace(str(p), "text")
+
+
+def _mk_trace(tmp_path, n=5 * 8 * 512 + 77, seed=0):
+    rng = np.random.default_rng(seed)
+    p = tmp_path / "t.bin"
+    (rng.integers(0, 1 << 12, n, dtype=np.int64) << 6).astype(
+        "<u8").tofile(p)
+    return str(p), n
+
+
+def test_replay_checkpoint_resume_bit_exact(tmp_path):
+    tf, _ = _mk_trace(tmp_path)
+    W = 512
+    clean = trace.replay_file(tf, window=W)
+    ck = str(tmp_path / "t.ckpt.npz")
+    faults.install(FaultPlan.parse("trace_loss@4"))
+    with pytest.raises(DataLoss):
+        trace.replay_file(tf, window=W, checkpoint_path=ck,
+                          checkpoint_every=1, resume=True)
+    faults.install(None)
+    assert os.path.exists(ck)
+    res = trace.replay_file(tf, window=W, checkpoint_path=ck,
+                            checkpoint_every=1, resume=True)
+    assert res.hist.tolist() == clean.hist.tolist()
+    assert res.total_count == clean.total_count
+    assert not os.path.exists(ck), "finished run must retire its checkpoint"
+
+
+def test_replay_checkpoint_corrupt_quarantined(tmp_path, capsys):
+    tf, _ = _mk_trace(tmp_path)
+    ck = tmp_path / "t.ckpt.npz"
+    ck.write_bytes(b"not an npz at all")
+    clean = trace.replay_file(tf, window=512)
+    res = trace.replay_file(tf, window=512, checkpoint_path=str(ck),
+                            resume=True)
+    assert res.hist.tolist() == clean.hist.tolist()
+    assert os.path.exists(str(ck) + ".corrupt")
+    assert "quarantined" in capsys.readouterr().err
+
+
+def test_replay_checkpoint_shape_mismatch_starts_fresh(tmp_path, capsys):
+    tf, _ = _mk_trace(tmp_path)
+    ck = str(tmp_path / "t.ckpt.npz")
+    faults.install(FaultPlan.parse("trace_loss@2"))
+    with pytest.raises(DataLoss):
+        trace.replay_file(tf, window=512, checkpoint_path=ck,
+                          checkpoint_every=1, resume=True)
+    faults.install(None)
+    # different window shape: the checkpoint must be ignored, not mixed in
+    clean = trace.replay_file(tf, window=256)
+    res = trace.replay_file(tf, window=256, checkpoint_path=ck,
+                            checkpoint_every=1, resume=True)
+    assert res.hist.tolist() == clean.hist.tolist()
+    assert "different run" in capsys.readouterr().err
+
+
+def test_pack_file_resume_byte_identical(tmp_path):
+    tf, _ = _mk_trace(tmp_path)
+    W = 512
+    meta_clean = trace.pack_file(tf, str(tmp_path / "clean.pack"), window=W)
+    crash = str(tmp_path / "crash.pack")
+    faults.install(FaultPlan.parse("trace_loss@3"))
+    with pytest.raises(DataLoss):
+        trace.pack_file(tf, crash, window=W)
+    faults.install(None)
+    assert os.path.exists(crash + ".journal")
+    meta = trace.pack_file(tf, crash, window=W, resume=True)
+    assert meta == meta_clean
+    assert (tmp_path / "clean.pack").read_bytes() == \
+        open(crash, "rb").read()
+    assert not os.path.exists(crash + ".journal"), "spent journal retires"
+
+
+def test_pack_file_resume_walks_back_past_missing_bytes(tmp_path):
+    # power-loss shape: a journal line can outlive the data it promises
+    # (data flushed but not durable) — resume must walk BACK to the last
+    # batch whose bytes exist, never truncate forward (zero-extension)
+    tf, _ = _mk_trace(tmp_path)
+    W = 512
+    trace.pack_file(tf, str(tmp_path / "clean.pack"), window=W)
+    crash = str(tmp_path / "y.pack")
+    faults.install(FaultPlan.parse("trace_loss@4"))
+    with pytest.raises(DataLoss):
+        trace.pack_file(tf, crash, window=W)
+    faults.install(None)
+    j = Journal(crash + ".journal")
+    b1 = j.get({"batch": 1})["out_bytes"]
+    b2 = j.get({"batch": 2})["out_bytes"]
+    with open(crash + ".tmp", "r+b") as f:
+        f.truncate((b1 + b2) // 2)   # batch 2's tail bytes "lost"
+    meta = trace.pack_file(tf, crash, window=W, resume=True)
+    assert (tmp_path / "clean.pack").read_bytes() == \
+        open(crash, "rb").read()
+    assert meta["n_lines"] > 0
+
+
+def test_pack_file_fresh_start_clears_stale_journal(tmp_path):
+    # regression: a FRESH pack must not leave an earlier crashed run's
+    # high-batch journal records behind — a later resume's contiguity
+    # scan would splice them onto the new prefix and truncate() past EOF
+    tf, _ = _mk_trace(tmp_path)
+    W = 512
+    trace.pack_file(tf, str(tmp_path / "clean.pack"), window=W)
+    clean_bytes = (tmp_path / "clean.pack").read_bytes()
+    crash = str(tmp_path / "x.pack")
+    faults.install(FaultPlan.parse("trace_loss@5"))   # run A: crash late
+    with pytest.raises(DataLoss):
+        trace.pack_file(tf, crash, window=W)
+    faults.install(None)
+    os.unlink(crash + ".tmp")      # A's partial output is lost entirely
+    faults.install(FaultPlan.parse("trace_loss@2"))   # run B: fresh, early
+    with pytest.raises(DataLoss):
+        trace.pack_file(tf, crash, window=W)
+    faults.install(None)
+    meta = trace.pack_file(tf, crash, window=W, resume=True)
+    assert open(crash, "rb").read() == clean_bytes
+    assert meta["n_lines"] > 0
+
+
+def test_replay_resilient_classifies_data_loss(tmp_path):
+    tf, _ = _mk_trace(tmp_path)
+    faults.install(FaultPlan.parse("trace_loss"))
+    with pytest.raises(DataLoss):
+        replay_file_resilient(tf, window=512, retry=Retry(backoff_s=0))
+
+
+# ---------------------------------------------------------------------------
+# journal + sweep resume
+
+
+def test_journal_atomic_records_and_torn_tail(tmp_path, capsys):
+    jp = tmp_path / "j.jsonl"
+    j = Journal(str(jp))
+    j.record({"a": 1}, x=2)
+    j.record({"a": 2}, x=3)
+    with open(jp, "a") as f:
+        f.write('{"key": {"a": 3}, "x":')   # torn final line (crash)
+    j2 = Journal(str(jp))
+    assert len(j2) == 2 and j2.get({"a": 2})["x"] == 3
+    assert "torn final line" in capsys.readouterr().err
+    # corruption in the MIDDLE is not a crash artifact: classified as a
+    # RETRYABLE CacheCorrupt (the journal is a rebuildable artifact —
+    # delete and recompute — unlike a truncated source trace)
+    lines = jp.read_text().splitlines()
+    lines[0] = "garbage"
+    jp.write_text("\n".join(lines) + "\n")
+    with pytest.raises(CacheCorrupt, match="line 1") as ei:
+        Journal(str(jp))
+    assert ei.value.retryable and not ei.value.fatal
+
+
+def test_interrupted_sweep_resumes_without_recompute(tmp_path, monkeypatch):
+    from pluss import sweep as sweep_mod
+
+    jp = str(tmp_path / "sweep.jsonl")
+    pts = sweep_mod.sweep(gemm(16), (1, 2), (2,), CFG, journal=jp)
+    # poison the engine: a resumed sweep that recomputes ANY finished
+    # point fails loudly
+    def boom(*a, **k):
+        raise AssertionError("recomputed a finished sweep point")
+    monkeypatch.setattr(engine, "run", boom)
+    monkeypatch.setattr(engine, "run_sliced", boom)
+    pts2 = sweep_mod.sweep(gemm(16), (1, 2), (2,), CFG, journal=jp,
+                           resume=True)
+    for p, q in zip(pts, pts2):
+        assert np.array_equal(p.curve, q.curve)
+        assert p.total_refs == q.total_refs
+        assert q.degradations[0] == "journal"
+
+
+def test_partially_journaled_sweep_computes_only_missing(tmp_path):
+    from pluss import sweep as sweep_mod
+
+    jp = str(tmp_path / "sweep.jsonl")
+    sweep_mod.sweep(gemm(16), (1,), (2,), CFG, journal=jp)
+    calls = []
+    real = engine.run
+
+    def counting(*a, **k):
+        calls.append(a)
+        return real(*a, **k)
+
+    engine.run = counting
+    try:
+        pts = sweep_mod.sweep(gemm(16), (1, 2), (2,), CFG, journal=jp,
+                              resume=True)
+    finally:
+        engine.run = real
+    assert len(calls) == 1, "only the missing (t=2) point may run"
+    direct = sweep_mod.sweep(gemm(16), (1, 2), (2,), CFG)
+    for p, q in zip(pts, direct):
+        assert np.array_equal(p.curve, q.curve)
+
+
+def test_cli_sweep_resume_flag(tmp_path, monkeypatch, capsys):
+    from pluss import cli
+
+    monkeypatch.chdir(tmp_path)
+    args = ["sweep", "--n", "16", "--cpu", "--sweep-threads", "1",
+            "--sweep-chunks", "4", "--cache-lines", "64", "--resume"]
+    cli.main(args)
+    first = capsys.readouterr()
+    assert os.path.exists(".pluss_sweep_gemm_16.jsonl")
+    cli.main(args)
+    second = capsys.readouterr()
+    # resumed rows restore from the journal (stamped in the table)
+    assert "journal" in second.out
+    assert "mr@64" in first.out and "mr@64" in second.out
+
+
+# ---------------------------------------------------------------------------
+# multihost: liveness + bring-up backoff (fast, single-process units; the
+# 2-process kill test lives below, marked slow like its harness sibling)
+
+
+def test_heartbeat_and_dead_worker_detection(tmp_path):
+    import time
+
+    from pluss.parallel import multihost
+
+    hb = str(tmp_path / "hb")
+    stop0 = multihost.start_heartbeat(hb, 0, interval_s=0.05)
+    stop1 = multihost.start_heartbeat(hb, 1, interval_s=0.05)
+    try:
+        deadline = time.time() + 5
+        while multihost.dead_workers(hb, 2, stale_s=10) and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        assert multihost.dead_workers(hb, 2, stale_s=10) == []
+        stop1()   # "kill" worker 1
+        time.sleep(0.6)
+        assert multihost.dead_workers(hb, 2, stale_s=0.5) == [1]
+    finally:
+        stop0()
+        stop1()
+
+
+def test_initialize_retries_with_backoff(monkeypatch):
+    import jax
+
+    from pluss.parallel import multihost
+
+    calls = []
+
+    def flaky(**kw):
+        calls.append(kw)
+        if len(calls) < 3:
+            raise ConnectionError("refused (synthetic)")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    multihost.initialize(coordinator_address="x:1", num_processes=2,
+                         process_id=0, max_retries=3, backoff_s=0.0)
+    assert len(calls) == 3
+
+    calls.clear()
+
+    def always(**kw):
+        calls.append(kw)
+        raise ConnectionError("refused")
+
+    monkeypatch.setattr(jax.distributed, "initialize", always)
+    with pytest.raises(CollectiveError, match="after 2 attempts"):
+        multihost.initialize(max_retries=2, backoff_s=0.0)
+
+
+def test_injected_collective_fault_then_recovery(monkeypatch):
+    import jax
+
+    from pluss.parallel import multihost
+
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: None)
+    faults.install(FaultPlan.parse("collective"))
+    # one injected connect failure, absorbed by the retry loop
+    multihost.initialize(max_retries=2, backoff_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# report surfaces
+
+
+def test_bench_emit_carries_degradations(capsys):
+    import bench
+
+    bench.emit("m", 100, 2.0, None, path="template",
+               degradations=("shrink_window",))
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(out)
+    assert rec["degradations"] == ["shrink_window"]
+    bench.emit("m2", 100, 2.0, None)
+    rec2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec2["degradations"] == []
+
+
+def test_readme_failure_model_is_synced():
+    """README 'Failure model & recovery' must name every error class, every
+    ladder rung, every fault kind, and the --resume surface (the same
+    test-synced contract as the PLxxx code table)."""
+    from pluss.resilience import errors
+    from pluss.resilience.faults import KIND_SITE
+    from pluss.resilience.ladder import LADDER, SHARD_LADDER, TRACE_LADDER
+
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    start = readme.index("## Failure model & recovery")
+    section = readme[start:]
+    for cls_ in (errors.PlussError, errors.ResourceExhausted,
+                 errors.CompileError, errors.ShareCapOverflow,
+                 errors.CollectiveError, errors.WorkerDied,
+                 errors.DataLoss, errors.CacheCorrupt):
+        assert cls_.__name__ in section, f"missing {cls_.__name__}"
+    for rung in set(LADDER) | set(SHARD_LADDER) | set(TRACE_LADDER):
+        assert rung in section, f"missing ladder rung {rung}"
+    for kind in KIND_SITE:
+        assert kind in section, f"missing fault kind {kind}"
+    assert "--resume" in section
+    assert "PLUSS_FAULT_PLAN" in section
+
+
+# ---------------------------------------------------------------------------
+# killed worker in the 2-process harness (slow, like test_multihost.py):
+# the coordinator must DETECT the death within the watchdog timeout and
+# salvage a bit-exact result on its local devices.
+
+WORKER = r"""
+import json, os, sys, time
+from pluss.utils.platform import force_cpu
+force_cpu(4)
+from pluss.parallel import multihost
+
+port, pid, out_path, hb_dir = (sys.argv[1], int(sys.argv[2]), sys.argv[3],
+                               sys.argv[4])
+multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                     num_processes=2, process_id=pid)
+
+from pluss.config import SamplerConfig
+from pluss.models import gemm
+# backend bring-up (a cross-process exchange) happens BEFORE the chaos
+# window opens: the fault models a worker dying MID-RUN, the scenario the
+# watchdog owns — a death during bring-up is initialize()'s timeout story
+mesh = multihost.global_mesh()
+stop = multihost.start_heartbeat(hb_dir, pid, interval_s=0.2)
+t0 = time.time()
+res = multihost.watched_shard_run(
+    gemm(16), SamplerConfig(cls=8), mesh=mesh, hb_dir=hb_dir,
+    num_processes=2, timeout_s=90, stale_s=3.0, first_beat_timeout_s=30,
+    window_accesses=1)
+if multihost.is_coordinator():
+    with open(out_path + ".tmp", "w") as f:
+        json.dump({
+            "detect_s": time.time() - t0,
+            "degradations": list(res.degradations),
+            "count": res.max_iteration_count,
+            "hist": res.noshare_dense.tolist(),
+            "share": [{str(k): v for k, v in d.items()}
+                      for d in res.share_raw],
+        }, f)
+    os.replace(out_path + ".tmp", out_path)
+stop()
+# skip interpreter-exit cleanup: the distributed client's atexit shutdown
+# barriers against the chaos-killed peer (hang, then SIGABRT from the
+# coordination service) — the salvage result is already durable above
+os._exit(0)
+"""
+
+
+@pytest.mark.slow
+def test_killed_worker_detected_and_salvaged(tmp_path):
+    import socket
+    import subprocess
+    import sys as _sys
+
+    port = socket.socket()
+    port.bind(("127.0.0.1", 0))
+    portno = port.getsockname()[1]
+    port.close()
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    out = tmp_path / "res.json"
+    hb_dir = tmp_path / "hb"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {**os.environ, "JAX_PLATFORMS": "cpu", "JAX_ENABLE_X64": "1",
+                "PYTHONPATH": repo + os.pathsep
+                + os.environ.get("PYTHONPATH", "")}
+    base_env.pop("XLA_FLAGS", None)
+    logs = [tmp_path / f"worker{i}.log" for i in range(2)]
+    handles: list = []
+    procs: list = []
+    try:
+        for i in range(2):
+            env = dict(base_env)
+            if i == 1:
+                # the chaos plan: worker 1 hard-exits from its heartbeat
+                # thread right after its first beat (SIGKILL-equivalent)
+                env["PLUSS_FAULT_PLAN"] = "kill_worker@1"
+            handles.append(open(logs[i], "w"))
+            procs.append(subprocess.Popen(
+                [_sys.executable, str(script), str(portno), str(i),
+                 str(out), str(hb_dir)],
+                env=env, stdout=handles[i], stderr=subprocess.STDOUT,
+            ))
+        procs[0].wait(timeout=600)
+        assert procs[0].returncode == 0, \
+            f"coordinator failed:\n{logs[0].read_text()[-3000:]}"
+        procs[1].wait(timeout=60)
+        assert procs[1].returncode == 43, \
+            f"worker 1 should have been chaos-killed (rc=43), got " \
+            f"{procs[1].returncode}:\n{logs[1].read_text()[-2000:]}"
+    finally:
+        try:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    try:
+                        p.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        pass
+        finally:
+            for h in handles:
+                h.close()
+    got = json.load(open(out))
+    assert got["detect_s"] < 120, "death must be detected within the timeout"
+    assert got["degradations"][-1] == "local_salvage"
+    assert got["degradations"][0].startswith("worker_died")
+
+    ref = engine.run(gemm(16), SamplerConfig(cls=8))
+    assert got["count"] == ref.max_iteration_count
+    assert got["hist"] == ref.noshare_dense.tolist()
+    assert got["share"] == [
+        {str(k): v for k, v in d.items()} for d in ref.share_raw
+    ]

@@ -39,4 +39,9 @@ def test_cli_sweep_mode(capsys):
               "--sweep-chunks", "4", "--cache-lines", "64,1024"])
     got = capsys.readouterr().out
     assert "predicted miss ratios" in got and "mr@1024" in got
-    assert len(got.strip().splitlines()) == 4  # title + header + 2 rows
+    lines = got.strip().splitlines()
+    # title + header + 2 rows, then the PL303 carried-level block (the
+    # static analyzer and the resilience stamps share this report surface)
+    assert lines[1].split()[:2] == ["threads", "chunk"]
+    assert len([l for l in lines if l.lstrip()[:1].isdigit()]) == 2
+    assert "carried levels (PL303):" in got
