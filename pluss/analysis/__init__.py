@@ -26,15 +26,18 @@ modes for the opt-in pre-pass.
 
 from __future__ import annotations
 
-from pluss.analysis import bounds, contract, deps, sharespan
+from pluss.analysis import (bounds, contract, deps, falseshare, footprint,
+                            schedule, sharespan)
 from pluss.analysis.diagnostics import (CODES, Diagnostic, Severity,
                                         error_count, format_json,
                                         format_text, sort_key, with_model)
+from pluss.config import DEFAULT, SamplerConfig
 from pluss.spec import LoopNestSpec
 
 
 def lint_spec(spec: LoopNestSpec) -> list[Diagnostic]:
-    """Run all four passes over one spec; diagnostics sorted errors-first.
+    """Run all four schedule-blind passes over one spec; diagnostics
+    sorted errors-first.
 
     Contract errors gate the semantic passes per nest: a nest the flatten
     rejects has no well-defined iteration domain, so bounds/race/share
@@ -50,8 +53,38 @@ def lint_spec(spec: LoopNestSpec) -> list[Diagnostic]:
     return sorted(diags, key=sort_key)
 
 
+def analyze_spec(spec: LoopNestSpec,
+                 cfg: SamplerConfig = DEFAULT
+                 ) -> tuple[list[Diagnostic], "footprint.Footprint"]:
+    """The schedule-AWARE analysis (``pluss analyze``): the lint passes
+    with the race stream placement-refined under ``cfg``'s chunk schedule
+    (PL304/PL305 — :mod:`pluss.analysis.schedule`), plus line-granular
+    false-sharing detection (PL5xx — :mod:`pluss.analysis.falseshare`)
+    and the footprint/MRC-bound report (:mod:`pluss.analysis.footprint`).
+
+    Returns ``(diagnostics, footprint)``.  The schedule-blind PL301/PL302
+    findings are REPLACED by their placement-refined versions (same codes
+    when a pair provably crosses threads, PL304 INFO when the schedule
+    serializes every pair); everything else from :func:`lint_spec` is
+    kept as-is.
+    """
+    diags = contract.check(spec)
+    bad = frozenset(d.nest for d in diags
+                    if d.severity is Severity.ERROR and d.nest is not None)
+    diags += bounds.check(spec, skip_nests=bad)
+    ana = deps.analyze(spec, skip_nests=bad)
+    blind = deps.check(spec, skip_nests=bad, analysis=ana)
+    diags += [d for d in blind if d.code not in ("PL301", "PL302")]
+    diags += schedule.check(spec, cfg, analysis=ana, skip_nests=bad)
+    diags += sharespan.check(spec, ana.classes)
+    diags += falseshare.check(spec, cfg, analysis=ana, skip_nests=bad)
+    return sorted(diags, key=sort_key), footprint.footprints(
+        spec, cfg, skip_nests=bad)
+
+
 __all__ = [
-    "CODES", "Diagnostic", "Severity", "lint_spec", "error_count",
-    "format_text", "format_json", "with_model",
-    "bounds", "contract", "deps", "sharespan",
+    "CODES", "Diagnostic", "Severity", "lint_spec", "analyze_spec",
+    "error_count", "format_text", "format_json", "with_model",
+    "bounds", "contract", "deps", "falseshare", "footprint", "schedule",
+    "sharespan",
 ]

@@ -50,7 +50,7 @@ import math
 
 import numpy as np
 
-from pluss.analysis.diagnostics import Diagnostic, Severity
+from pluss.analysis.diagnostics import Diagnostic, Severity, shown
 from pluss.analysis.walk import (AddrForm, RefSite, addr_form,
                                  inner_profile, ref_sites)
 from pluss.spec import LoopNestSpec, SpecContractError
@@ -88,11 +88,13 @@ def _profile(site: RefSite) -> SiteProfile | None:
 _PAIR_BLOCK = 1024
 
 
-def _feasible(p1: SiteProfile, p2: SiteProfile, rel) -> bool:
-    """True when ``addr_1(k1, ·) = addr_2(k2, ·)`` has a feasible solution
-    with ``rel(k1, k2)`` (a broadcastable boolean relation on the two
-    parallel-index grids).  Exact over k; GCD + interval (Banerjee) over
-    inner indices.
+def _feasible(p1: SiteProfile, p2: SiteProfile, rel,
+              delta: int = 0) -> bool:
+    """True when ``addr_1(k1, ·) - addr_2(k2, ·) = delta`` has a feasible
+    solution with ``rel(k1, k2)`` (a broadcastable boolean relation on the
+    two parallel-index grids).  Exact over k; GCD + interval (Banerjee)
+    over inner indices.  ``delta=0`` is the same-element (race) test; the
+    false-sharing pass probes the sub-line offsets ``0 < |delta| < E``.
     """
     f1, f2 = p1.form, p2.form
     g = math.gcd(f1.inner_gcd(), f2.inner_gcd())
@@ -103,7 +105,7 @@ def _feasible(p1: SiteProfile, p2: SiteProfile, rel) -> bool:
                        dtype=np.int64)[:, None]
         sl = slice(b0, b0 + len(k1))
         # need: (inner_1 - inner_2) = D(k1, k2)
-        D = base2 - (f1.const + f1.k_coef * k1)
+        D = base2 - (f1.const + f1.k_coef * k1) + delta
         L = p1.lo[sl, None] - p2.hi[None, :]
         H = p1.hi[sl, None] - p2.lo[None, :]
         divisible = (D % g == 0) if g else (D == 0)
@@ -292,13 +294,11 @@ def check(spec: LoopNestSpec,
             if not names:
                 continue
             kind = "write-write" if code == "PL301" else "read-write"
-            shown = ", ".join(names[:4]) + (
-                f" (+{len(names) - 4} more)" if len(names) > 4 else "")
             diags.append(Diagnostic(
                 code=code, severity=Severity.WARNING,
                 message=f"{kind} conflict on '{array}' across parallel "
-                        f"iterations: {shown} — the parallel pragma "
-                        "asserts this is intended",
+                        f"iterations: {shown(names)} — the parallel "
+                        "pragma asserts this is intended",
                 path=first_path[code], nest=ni, array=array,
             ))
     for path, rc in sorted(ana.classes.items()):

@@ -16,6 +16,12 @@ Code families mirror the analyzer's four passes:
 - ``PL4xx`` contract (:mod:`pluss.analysis.contract`): the structural
   restrictions ``spec.flatten_nest`` / ``flatten_nest_quad`` enforce,
   surfaced as records with tree paths instead of bare ``ValueError``.
+- ``PL30x`` (304/305) schedule (:mod:`pluss.analysis.schedule`):
+  placement-refined race/reuse verdicts under a concrete chunk schedule
+  (emitted by ``pluss analyze``, never by the schedule-blind ``lint``).
+- ``PL5xx`` falseshare (:mod:`pluss.analysis.falseshare`): line-granular
+  cross-thread false-sharing detection (also ``analyze``-only — it needs
+  the machine model's element and line widths).
 
 Severity semantics: ERROR means the spec is wrong (out-of-bounds access,
 undeclared array, contract violation) — ``pluss lint`` exits nonzero.
@@ -60,6 +66,15 @@ CODES: dict[str, tuple[str, str]] = {
     "PL302": ("race", "cross-thread read-write conflict on the parallel "
                       "dimension"),
     "PL303": ("race", "reuse carried-level classification"),
+    "PL304": ("race", "conflict is provably thread-private under the "
+                      "analyzed chunk schedule (placement-refined)"),
+    "PL305": ("race", "schedule-refined reuse classification"),
+    "PL501": ("falseshare", "cross-thread write-write false sharing on a "
+                            "cache line (same line, different elements)"),
+    "PL502": ("falseshare", "cross-thread read-write false sharing on a "
+                            "cache line (same line, different elements)"),
+    "PL503": ("falseshare", "write references proven free of false "
+                            "sharing under the analyzed schedule"),
     "PL401": ("contract", "the parallel (outermost) loop must be "
                           "rectangular"),
     "PL402": ("contract", "inner bound leaves the declared [0, trip] "
@@ -105,6 +120,14 @@ class Diagnostic:
         d = dataclasses.asdict(self)
         d["severity"] = str(self.severity)
         return {k: v for k, v in d.items() if v is not None and v != ""}
+
+
+def shown(names: list[str], limit: int = 4) -> str:
+    """Truncated pair/name list for diagnostic messages: the first
+    ``limit`` entries plus a '+N more' tail (one home for the idiom the
+    race, schedule, and false-sharing passes all use)."""
+    return ", ".join(names[:limit]) + (
+        f" (+{len(names) - limit} more)" if len(names) > limit else "")
 
 
 def error_count(diags: list[Diagnostic]) -> int:

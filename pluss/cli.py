@@ -92,9 +92,32 @@ def banner_of(backend: str) -> str:
     return {"vmap": "TPU VMAP", "shard": "TPU SHARD", "seq": "TPU SEQ"}[backend]
 
 
-def _lint_main(args, out) -> int:
-    """``pluss lint <model|--all> [--json]`` — pure host analysis, exits 1
-    when any model has ERROR-level diagnostics."""
+def _footprint_doc(fp, bracket) -> dict:
+    """JSON view of one model's footprint/MRC-bound report."""
+    return {
+        "total_lines": fp.total,
+        "per_array": {a: int(n) for a, n in zip(fp.arrays, fp.per_array)},
+        "per_thread_cold": [int(c) for c in fp.cold],
+        "accesses": fp.accesses,
+        "mrc_floor": bracket.floor,
+        "mrc_plateau_bounds": [bracket.c_lo, bracket.c_hi],
+        "guaranteed_reuse": bracket.guaranteed_reuse,
+        "levels": [
+            {"nest": lv.nest, "path": lv.path, "depth": lv.depth,
+             "lines_lo": lv.lines_lo, "lines_hi": lv.lines_hi}
+            for lv in fp.levels
+        ],
+    }
+
+
+def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
+    """``pluss lint|analyze <model|--all> [--json]`` — pure host analysis,
+    exits 1 when any model has ERROR-level diagnostics.  ``analyze``
+    (``cfg`` set) adds the schedule-aware passes: placement-refined race
+    verdicts (PL304/PL305), line-granular false-sharing detection
+    (PL5xx), and the footprint/MRC-bound report."""
+    import json as json_mod
+
     from pluss import analysis
 
     if args.all:
@@ -104,32 +127,56 @@ def _lint_main(args, out) -> int:
     else:
         targets = [(args.model, REGISTRY[args.model](args.n))]
     all_diags = []
+    footprints: dict[str, dict] = {}
     errors = 0
     for name, spec in targets:
-        diags = analysis.with_model(analysis.lint_spec(spec), spec.name)
-        all_diags += diags
+        if cfg is None:
+            diags = analysis.lint_spec(spec)
+        else:
+            diags, fp = analysis.analyze_spec(spec, cfg)
+            footprints[spec.name] = _footprint_doc(
+                fp, analysis.footprint.mrc_bracket(spec, cfg, fp))
+        all_diags += analysis.with_model(diags, spec.name)
         errors += analysis.error_count(diags)
+    mode = "lint" if cfg is None else "analyze"
     if args.json:
-        out.write(analysis.format_json(all_diags) + "\n")
+        doc = json_mod.loads(analysis.format_json(all_diags))
+        if cfg is not None:
+            doc["schedule"] = {"threads": cfg.thread_num,
+                               "chunk": cfg.chunk_size,
+                               "ds": cfg.ds, "cls": cfg.cls}
+            doc["footprint"] = footprints
+        out.write(json_mod.dumps(doc, indent=1) + "\n")
     else:
         text = analysis.format_text(all_diags)
         if text:
             out.write(text + "\n")
+        if cfg is not None:
+            for name, doc in footprints.items():
+                out.write(
+                    f"{name}: footprint {doc['total_lines']} lines "
+                    f"({', '.join(f'{a}={n}' for a, n in doc['per_array'].items())}); "
+                    f"cold/thread {doc['per_thread_cold']}; MRC floor "
+                    f"{doc['mrc_floor']:.6g}, plateau in "
+                    f"[{doc['mrc_plateau_bounds'][0]}, "
+                    f"{doc['mrc_plateau_bounds'][1]}]\n")
         n_warn = sum(1 for d in all_diags
                      if d.severity is analysis.Severity.WARNING)
-        out.write(f"pluss lint: {len(targets)} model(s), {errors} error(s), "
-                  f"{n_warn} warning(s)\n")
+        out.write(f"pluss {mode}: {len(targets)} model(s), {errors} "
+                  f"error(s), {n_warn} warning(s)\n")
     return 1 if errors else 0
 
 
-def _verify_spec(spec, out_err) -> int:
-    """The ``--verify`` pre-pass: lint the spec before any compilation.
-    Returns the number of ERROR diagnostics (caller aborts when nonzero);
-    errors and warnings go to stderr so they never pollute the acc/speed
-    block diffs."""
+def _verify_spec(spec, cfg: SamplerConfig, out_err) -> int:
+    """The ``--verify`` pre-pass: the full schedule-aware analysis (lint
+    + placement refinement + false sharing) under the RUN's own schedule,
+    before any compilation.  Returns the number of ERROR diagnostics
+    (caller aborts when nonzero); errors and warnings go to stderr so
+    they never pollute the acc/speed block diffs."""
     from pluss import analysis
 
-    diags = analysis.with_model(analysis.lint_spec(spec), spec.name)
+    diags, _ = analysis.analyze_spec(spec, cfg)
+    diags = analysis.with_model(diags, spec.name)
     text = analysis.format_text(diags)
     if text:
         out_err.write(text + "\n")
@@ -143,16 +190,17 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="pluss", description=__doc__)
     p.add_argument("mode",
                    choices=("acc", "speed", "mrc", "trace", "sweep",
-                            "sample", "lint"))
+                            "sample", "lint", "analyze"))
     p.add_argument("--all", action="store_true",
-                   help="lint mode: analyze every registered model family "
-                        "(at each builder's default size) instead of "
-                        "--model/--n")
+                   help="lint/analyze mode: analyze every registered model "
+                        "family (at each builder's default size) instead "
+                        "of --model/--n")
     p.add_argument("--json", action="store_true",
-                   help="lint mode: machine-readable diagnostics")
+                   help="lint/analyze mode: machine-readable diagnostics")
     p.add_argument("--verify", action="store_true",
-                   help="run the static spec analyzer before the engine "
-                        "modes; ERROR diagnostics abort the run")
+                   help="run the schedule-aware static analyzer before "
+                        "the engine modes; ERROR diagnostics abort the "
+                        "run")
     p.add_argument("--rates", default="0.05,0.1,0.25,0.5,1.0",
                    help="sample-mode sampling rates (comma list)")
     p.add_argument("--sample-mode", default="uniform",
@@ -203,10 +251,15 @@ def main(argv: list[str] | None = None) -> int:
                         "DIR (view with tensorboard or xprof)")
     args = p.parse_args(argv)
 
-    if args.mode == "lint":
+    if args.mode in ("lint", "analyze"):
         # pure host analysis: no accelerator probe, no platform setup —
-        # a broken spec must be reportable from any box, instantly
-        return _lint_main(args, sys.stdout)
+        # a broken spec must be reportable from any box, instantly.
+        # analyze adds the schedule-aware passes under the CLI's own
+        # (--threads, --chunk) schedule
+        cfg = SamplerConfig(thread_num=args.threads,
+                            chunk_size=args.chunk) \
+            if args.mode == "analyze" else None
+        return _lint_main(args, sys.stdout, cfg)
 
     if args.cpu:
         from pluss.utils.platform import force_cpu
@@ -226,13 +279,13 @@ def main(argv: list[str] | None = None) -> int:
             force_cpu(8)
 
     spec = REGISTRY[args.model](args.n)
+    cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk)
     if args.verify:
-        n_err = _verify_spec(spec, sys.stderr)
+        n_err = _verify_spec(spec, cfg, sys.stderr)
         if n_err:
             print(f"pluss: --verify found {n_err} error(s) in "
                   f"{spec.name}; refusing to run", file=sys.stderr)
             return 2
-    cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk)
     backends_explicit = args.backends is not None
     backends = [b.strip()
                 for b in (args.backends or "vmap,shard,seq").split(",")
@@ -307,6 +360,11 @@ def main(argv: list[str] | None = None) -> int:
         levels = sweep_mod.carried_levels(spec)
         if levels:
             out.write(levels + "\n")
+        # footprint + per-schedule false-sharing stamps (the analyzer's
+        # schedule-aware passes, sharing one profiled analysis)
+        sched_block = sweep_mod.schedule_analysis(spec, pts)
+        if sched_block:
+            out.write(sched_block + "\n")
     else:  # trace: dynamic replay (BASELINE config 5; bypasses CRI like the
         # reference's pluss_access path — see pluss/trace.py)
         if not args.file:
@@ -332,9 +390,40 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
         if backends == ["shard"]:
-            rep = trace_mod.shard_replay(
-                trace_mod.load_trace(args.file, args.fmt), cls=cfg.cls,
-                window=win)
+            import jax as _jax
+
+            if args.fmt == "u64" and _jax.process_count() > 1:
+                # multi-process: shard_replay_file's single-host compactor
+                # would diverge across processes (it raises) — keep the
+                # collectives-only in-memory path, which stays correct
+                # (every process compacts the full trace identically)
+                if args.resume or args.journal:
+                    print("pluss: --resume/--journal have no effect on "
+                          "multi-process sharded replay", file=sys.stderr)
+                rep = trace_mod.shard_replay(
+                    trace_mod.load_trace(args.file, args.fmt),
+                    cls=cfg.cls, window=win)
+            elif args.fmt == "u64":
+                # disk-streamed sharded replay: bounded host memory, and
+                # --journal/--resume arm the sharded checkpoint (PR-2
+                # follow-up: the journal substrate now covers this path)
+                ckpt = None
+                if args.resume or args.journal:
+                    ckpt = args.journal or (args.file + ".shard.ckpt")
+                    print(f"pluss: shard-trace checkpoint at {ckpt} "
+                          f"(resume {'on' if args.resume else 'off'})",
+                          file=sys.stderr)
+                rep = trace_mod.shard_replay_file(
+                    args.file, cls=cfg.cls, window=win,
+                    checkpoint_path=ckpt, resume=args.resume)
+            else:
+                if args.resume or args.journal:
+                    print("pluss: --resume/--journal have no effect on "
+                          f"sharded {args.fmt} traces (checkpointing is "
+                          "u64-only)", file=sys.stderr)
+                rep = trace_mod.shard_replay(
+                    trace_mod.load_trace(args.file, args.fmt),
+                    cls=cfg.cls, window=win)
         else:
             from pluss.resilience import replay_file_resilient
 

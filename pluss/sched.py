@@ -39,19 +39,41 @@ class ChunkSchedule:
     step: int = 1
     thread_num: int = 4
 
+    def __post_init__(self) -> None:
+        # trip == 0 is a VALID empty schedule (an analyzer may see nests
+        # whose parallel loop never runs); everything else out of range
+        # would silently produce nonsense (a negative trip makes n_chunks
+        # -1, step 0 collapses every iteration onto one value)
+        if self.chunk_size < 1:
+            raise ValueError(f"chunk_size must be >= 1, got {self.chunk_size}")
+        if self.trip < 0:
+            raise ValueError(f"trip must be >= 0, got {self.trip}")
+        if self.step == 0:
+            raise ValueError("step must be nonzero")
+        if self.thread_num < 1:
+            raise ValueError(f"thread_num must be >= 1, got {self.thread_num}")
+
     @property
     def last(self) -> int:
         return self.start + (self.trip - 1) * self.step
 
     @property
     def n_chunks(self) -> int:
-        """``avail_chunk`` (pluss_utils.h:300)."""
+        """``avail_chunk`` (pluss_utils.h:300); 0 for an empty loop."""
         return -(-self.trip // self.chunk_size)
 
     # -- per-chunk geometry ---------------------------------------------------
 
     def chunk_index_range(self, cid: int) -> tuple[int, int]:
-        """[begin, end) of chunk ``cid`` in iteration-index space (0..trip)."""
+        """[begin, end) of chunk ``cid`` in iteration-index space (0..trip).
+
+        Rejects chunk ids outside ``[0, n_chunks)`` — in particular EVERY
+        cid of a ``trip == 0`` schedule, whose ``chunk_bounds`` used to
+        return an inverted garbage range instead of failing."""
+        if not 0 <= cid < self.n_chunks:
+            raise ValueError(
+                f"chunk id {cid} outside [0, {self.n_chunks}) "
+                f"(trip={self.trip}, chunk_size={self.chunk_size})")
         b = cid * self.chunk_size
         return b, min(b + self.chunk_size, self.trip)
 

@@ -74,6 +74,18 @@ class Ref:
     machine), but the static analyzer (:mod:`pluss.analysis`) needs the
     distinction to prove or refute cross-thread races on the parallel
     dimension, so every model spec declares it.
+
+    ``dtype_bytes``: optional element width in bytes for this reference's
+    array, overriding the machine-model default ``SamplerConfig.ds`` in
+    the false-sharing analysis (:mod:`pluss.analysis.falseshare`) — a
+    float32 field in a double-default model packs twice as many elements
+    per cache line, which is exactly what decides whether neighboring
+    parallel iterations falsely share a line.  The engine's element→line
+    rule — and therefore the footprint/cold oracle
+    (:mod:`pluss.analysis.footprint`), which must match the engine
+    exactly — stays on ``cfg.ds`` (one global width per run, like the
+    reference's ``-DDS``); all refs of one array must agree on the
+    override.
     """
 
     name: str
@@ -82,6 +94,7 @@ class Ref:
     addr_base: int = 0
     share_span: int | None = None
     is_write: bool = False
+    dtype_bytes: int | None = None
 
 
 @dataclasses.dataclass(frozen=True)

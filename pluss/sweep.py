@@ -138,6 +138,40 @@ def table(points: Iterable[SweepPoint], cache_lines: Sequence[int]) -> str:
     )
 
 
+def schedule_analysis(spec: LoopNestSpec,
+                      points: Iterable[SweepPoint]) -> str:
+    """Schedule-aware analysis block for the sweep report: the spec's
+    static footprint (schedule-independent: the union over threads is the
+    global distinct-line count) and, per swept config, the false-sharing
+    verdict under THAT schedule — the quantity that actually changes with
+    (threads, chunk), which is the whole point of sweeping them.
+
+    Built from the analyzer's own passes (not a re-derivation), with the
+    expensive schedule-blind profiling shared across all points."""
+    from pluss.analysis import Severity, deps, falseshare, footprint
+
+    points = list(points)
+    if not points:
+        return ""
+    fp = footprint.footprints(spec, points[0].cfg)
+    ana = deps.analyze(spec)
+    lines = [
+        "  footprint: %d lines (%s); %d accesses" % (
+            fp.total,
+            ", ".join(f"{a}={int(n)}"
+                      for a, n in zip(fp.arrays, fp.per_array)),
+            fp.accesses),
+    ]
+    for p in points:
+        diags = falseshare.check(spec, p.cfg, analysis=ana)
+        warns = sorted({f"{d.code}:{d.array}" for d in diags
+                        if d.severity is Severity.WARNING})
+        lines.append(
+            f"  threads={p.cfg.thread_num} chunk={p.cfg.chunk_size}: "
+            f"false sharing {', '.join(warns) if warns else 'none'}")
+    return "schedule-aware analysis:\n" + "\n".join(lines)
+
+
 def carried_levels(spec: LoopNestSpec) -> str:
     """The static analyzer's PL303 carried-level classifications as a
     compact report block (ROADMAP PR-1 follow-up): one line per annotated

@@ -180,10 +180,14 @@ def _pack_ids(ids: np.ndarray, n_lines: int) -> np.ndarray:
 
 
 def _widen_ids(line_w):
-    """Inverse of :func:`_pack_ids` on device (u8 [n,3] | u16 | int32)."""
-    if line_w.dtype == jnp.uint8:      # 24-bit packed
+    """Inverse of :func:`_pack_ids` on device (u8 [n,3] 24-bit | u8 [n,4]
+    little-endian int32 | u16 | int32)."""
+    if line_w.dtype == jnp.uint8:      # byte-packed (24-bit or LE int32)
         b = line_w.astype(jnp.int32)
-        return b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)
+        out = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)
+        if line_w.shape[-1] == 4:      # i32 wire format (ids < 2^31)
+            out = out | (b[:, 3] << 24)
+        return out
     if line_w.dtype == jnp.uint16:
         return line_w.astype(jnp.int32)
     return line_w
@@ -669,22 +673,30 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
 def pack_file(path: str, out_path: str, cls: int = 64,
               window: int = TRACE_WINDOW, precompacted: bool = False,
               limit_refs: int | None = None,
-              resume: bool = False) -> dict:
+              resume: bool = False, _wide: bool = False) -> dict:
     """Compact + pack a raw u64 trace ONCE, writing the replay wire format.
 
     Streams the trace through the same incremental compactor as
-    :func:`replay_file` and writes the packed dense-id stream (24-bit/ref
-    for tables under 2^24 lines, else int32) plus a JSON sidecar
-    (``out_path + '.json'``) with ``{n, n_lines, fmt}``.  The host-side
-    compaction of a 1e9-ref trace costs minutes on this box's single core;
-    paying it once lets :func:`replay_resident` stage straight from disk on
-    every later run.  Returns the sidecar dict.
+    :func:`replay_file` and writes the packed dense-id stream plus a JSON
+    sidecar (``out_path + '.json'``) with ``{n, n_lines, fmt}``.  The
+    host-side compaction of a 1e9-ref trace costs minutes on this box's
+    single core; paying it once lets :func:`replay_resident` stage
+    straight from disk on every later run.  Returns the sidecar dict.
+
+    Wire format: 24-bit/ref (``fmt: u24``) while the id table fits 2^24
+    lines — decided by the FINAL table size, which is unknown mid-stream,
+    so the 3-byte format is written optimistically and the pack RESTARTS
+    in the 4-byte little-endian int32 format (``fmt: i32``) the moment
+    the table overflows (real traces that blow 2^24 lines blow it early,
+    so the wasted prefix is small).  The staging/replay side widens
+    either format on device (:func:`_widen_ids`).
 
     Progress journals to ``out_path + '.journal'`` per flushed batch (the
     output offset + the compactor's id table); ``resume=True`` after a
     crash truncates the partial ``.tmp`` to the last journaled batch
     boundary and continues — byte-identical to an uninterrupted pack, with
-    zero batches recompacted before the checkpoint.
+    zero batches recompacted before the checkpoint.  The journal records
+    the wire format, so a resumed i32 pack stays i32.
     """
     import json
     import os
@@ -705,11 +717,19 @@ def pack_file(path: str, out_path: str, cls: int = 64,
     jpath = out_path + ".journal"
     b0 = 0
     fp = _trace_fingerprint(path)
+    fmt = "i32" if _wide else "u24"
+    if resume and not _wide and os.path.exists(jpath):
+        rec0 = Journal(jpath).get({"batch": 0})
+        if rec0 is not None and rec0.get("fmt") == "i32":
+            # the crashed pack had already fallen back to the wide wire
+            # format; resume in it instead of re-deciding from scratch
+            return pack_file(path, out_path, cls, window, precompacted,
+                            limit_refs, resume=True, _wide=True)
     if resume and os.path.exists(jpath) and os.path.exists(tmp):
         jr = Journal(jpath)
         best = None
         ident = {"n": n, "window": window, "cls": cls,
-                 "precompacted": bool(precompacted), "fp": fp}
+                 "precompacted": bool(precompacted), "fp": fp, "fmt": fmt}
         for b in range(n_batches):
             rec = jr.get({"batch": b})
             if rec is None:
@@ -758,16 +778,26 @@ def pack_file(path: str, out_path: str, cls: int = 64,
                 lines = raw.astype(np.int64) if precompacted \
                     else raw.astype(np.int64) >> shift
                 ids = comp.map(lines)
-            # whole-file single format: 24-bit packing is decided by the
-            # FINAL table size, which is unknown mid-stream — write the
-            # 3-byte format optimistically and restart wide on overflow
-            # (real traces that blow 2^24 lines blow it early)
-            if comp.next_free >= 1 << 24:
-                raise RuntimeError(
-                    f"line table overflowed 2^24 ids at batch {b}; "
-                    "resident staging needs the int32 fallback (unbuilt: "
-                    "no workload here needs it)")
-            _pack24(ids).tofile(out)
+            if not _wide and comp.next_free >= 1 << 24:
+                import sys
+
+                print(f"trace: line table overflowed 2^24 ids at batch "
+                      f"{b}; restarting the pack in the int32 wire "
+                      "format", file=sys.stderr)
+                try:
+                    os.unlink(jpath)
+                except OSError:
+                    pass
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return pack_file(path, out_path, cls, window,
+                                precompacted, limit_refs, _wide=True)
+            if _wide:
+                ids.astype("<i4").tofile(out)
+            else:
+                _pack24(ids).tofile(out)
             out.flush()
             # the DATA must be durable before the journal line that
             # promises it exists — otherwise a power loss can leave a
@@ -776,9 +806,9 @@ def pack_file(path: str, out_path: str, cls: int = 64,
             journal.record({"batch": b}, out_bytes=out.tell(),
                            comp=comp.snapshot(), n=n, window=window,
                            cls=cls, precompacted=bool(precompacted),
-                           fp=fp)
+                           fp=fp, fmt=fmt)
     os.replace(tmp, out_path)
-    meta = {"n": n, "n_lines": comp.next_free, "fmt": "u24"}
+    meta = {"n": n, "n_lines": comp.next_free, "fmt": fmt}
     with open(out_path + ".json", "w") as f:
         json.dump(meta, f)
     try:
@@ -867,14 +897,16 @@ def stage_resident(packed_path: str, meta: dict,
                    limit_refs: int | None = None,
                    upload_budget_s: float | None = None):
     """Upload a packed trace into HBM.  Returns ``(resident, n_run, stats)``
-    — the device array ([n_batches, WINDOWS_PER_BATCH, window, 3] u8), the
-    staged ref count (may be a prefix under ``upload_budget_s``), and
-    ``{upload_s, upload_bytes}``.  Staging once serves any number of
+    — the device array ([n_batches, WINDOWS_PER_BATCH, window, 3|4] u8 —
+    last dim per the ``u24``/``i32`` wire format), the staged ref count
+    (may be a prefix under ``upload_budget_s``), and ``{upload_s,
+    upload_bytes}``.  Staging once serves any number of
     :func:`replay_staged` calls."""
     import time
 
-    if meta["fmt"] != "u24":
+    if meta["fmt"] not in ("u24", "i32"):
         raise ValueError(f"unknown packed trace format {meta['fmt']!r}")
+    bpr = 3 if meta["fmt"] == "u24" else 4   # wire bytes per ref
     n = meta["n"] if limit_refs is None else min(meta["n"], limit_refs)
     if n == 0:
         return None, 0, {"upload_s": 0.0, "upload_bytes": 0}
@@ -883,18 +915,19 @@ def stage_resident(packed_path: str, meta: dict,
     stage = _stage_fn(jax.default_backend())
 
     t0 = time.perf_counter()
-    resident = jnp.zeros((n_batches, WINDOWS_PER_BATCH, window, 3), jnp.uint8)
+    resident = jnp.zeros((n_batches, WINDOWS_PER_BATCH, window, bpr),
+                         jnp.uint8)
     staged = 0
     with open(packed_path, "rb") as f:
         for b in range(n_batches):
             raw = np.fromfile(f, dtype=np.uint8,
-                              count=min(batch, n - b * batch) * 3)
-            pad = batch * 3 - len(raw)
+                              count=min(batch, n - b * batch) * bpr)
+            pad = batch * bpr - len(raw)
             if pad:
                 raw = np.concatenate([raw, np.zeros(pad, np.uint8)])
             resident = stage(
                 resident,
-                jnp.asarray(raw.reshape(1, WINDOWS_PER_BATCH, window, 3)),
+                jnp.asarray(raw.reshape(1, WINDOWS_PER_BATCH, window, bpr)),
                 jnp.int32(b))
             staged = b + 1
             if upload_budget_s is not None and staged < n_batches \
@@ -912,7 +945,7 @@ def stage_resident(packed_path: str, meta: dict,
         # budget-shrunk prefix: keep only the staged leading batches
         resident = jax.lax.slice_in_dim(resident, 0, staged, axis=0)
     return resident, min(n, staged * batch), {
-        "upload_s": upload_s, "upload_bytes": staged * batch * 3}
+        "upload_s": upload_s, "upload_bytes": staged * batch * bpr}
 
 
 def replay_staged(resident, n_lines: int, n_run: int,
@@ -1046,7 +1079,10 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
                       window: int = TRACE_WINDOW,
                       precompacted: bool = False,
                       batch_windows: int = WINDOWS_PER_BATCH,
-                      initial_capacity: int = 1 << 20) -> ReplayResult:
+                      initial_capacity: int = 1 << 20,
+                      checkpoint_path: str | None = None,
+                      checkpoint_every: int = 4,
+                      resume: bool = False) -> ReplayResult:
     """Device-sharded replay streamed from DISK in bounded host memory.
 
     :func:`shard_replay` holds the whole compacted trace in host RAM —
@@ -1065,6 +1101,18 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
     multi-process ``jax.distributed`` each process would discover clusters
     in a different order; that needs a pre-agreed table, so this path
     requires a single process (or ``precompacted`` ids).
+
+    ``checkpoint_path`` + ``resume``: crash recovery, same contract as
+    :func:`replay_file` — every ``checkpoint_every`` step calls, the
+    sharded device carries (last_pos / hist / head_pos, all [D, cap]) are
+    fetched and written to ``checkpoint_path + '.npz'`` while the stream
+    position, compactor table, and run identity journal to
+    ``checkpoint_path`` as an atomic JSONL record
+    (:class:`pluss.resilience.journal.Journal`, PR-2 substrate);
+    ``resume=True`` restores the carries sharded back onto the mesh and
+    continues from the recorded call — bit-identical to an uninterrupted
+    run.  A checkpoint for a different (file, shape, mesh) identity is
+    ignored with a notice, never spliced.
     """
     import os
 
@@ -1072,6 +1120,7 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from pluss.parallel.shard import _capture_heads, _vary, default_mesh
+    from pluss.resilience.journal import Journal
     from pluss.utils import compat
 
     mesh = mesh or default_mesh()
@@ -1109,6 +1158,9 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
         The read clips at BOTH the stream end and the segment end — when S
         is not a multiple of batch_windows the final slice would otherwise
         spill into segment d+1, whose owner also processes those refs."""
+        from pluss.resilience import faults
+
+        faults.check("trace.read_batch")  # chaos injection site
         lo = d * S * window + k * SB * window
         seg_end = (d + 1) * S * window
         count = max(0, min(SB * window, n - lo, seg_end - lo))
@@ -1200,9 +1252,82 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
             jax.device_put(np.full((D, cap), -1, npdt), sh),
         )
 
-    last_pos, hist, head_pos = dev_full(capacity)
+    ident = {"n": n, "window": window, "cls": cls,
+             "precompacted": bool(precompacted), "D": D, "SB": SB,
+             "fp": _trace_fingerprint(path) if checkpoint_path else ""}
+    jr = Journal(checkpoint_path) if checkpoint_path else None
+    npz_path = checkpoint_path + ".npz" if checkpoint_path else None
+    k0 = 0
+    last_pos = hist = head_pos = None
+    #: a pre-existing checkpoint belonging to a DIFFERENT run must not be
+    #: retired at the end of THIS run — that run may still want to resume
+    foreign_ckpt = False
+    if jr is not None and len(jr):
+        rec0 = jr.get({"shard_ckpt": 1})
+        foreign_ckpt = rec0 is None or any(
+            rec0.get(k_) != v for k_, v in ident.items())
+        if foreign_ckpt and not resume:
+            # the caller aimed a fresh run at someone else's checkpoint:
+            # the first checkpoint write below will overwrite it — say so
+            # BEFORE it happens, not after the other run fails to resume
+            import sys
+
+            print(f"trace: {checkpoint_path} holds a checkpoint for a "
+                  "DIFFERENT run; this run will overwrite it at its "
+                  "first checkpoint", file=sys.stderr)
+    if resume and jr is not None and len(jr) and os.path.exists(npz_path):
+        rec = jr.get({"shard_ckpt": 1})
+        if rec is None or any(rec.get(k_) != v for k_, v in ident.items()):
+            import sys
+
+            print(f"trace: shard checkpoint {checkpoint_path} is for a "
+                  "different run; starting fresh", file=sys.stderr)
+        else:
+            try:
+                with np.load(npz_path) as z:
+                    if int(z["k_next"]) != rec["k_next"]:
+                        raise ValueError(
+                            "journal/array checkpoint out of step")
+                    k0 = int(z["k_next"])
+                    capacity = int(z["capacity"])
+                    last_pos = jax.device_put(
+                        z["last_pos"].astype(npdt), sh)
+                    hist = jax.device_put(z["hist"].astype(npdt), sh)
+                    head_pos = jax.device_put(
+                        z["head_pos"].astype(npdt), sh)
+                    comp = _Compactor.restore(rec["comp"])
+                import sys
+
+                print(f"trace: resuming sharded replay at call "
+                      f"{k0}/{n_calls}", file=sys.stderr)
+            except Exception as e:
+                from pluss.resilience.errors import quarantine_artifact
+
+                quarantine_artifact(npz_path, "shard replay-checkpoint",
+                                    e, action="starting fresh")
+                k0 = 0
+                last_pos = None
+    if last_pos is None:
+        last_pos, hist, head_pos = dev_full(capacity)
+
+    def save_ckpt(k_next: int) -> None:
+        # d2h fetch synchronizes the mesh — the price of a durable point;
+        # the arrays land first (atomic replace), then the journal line
+        # that promises them (same ordering rule as pack_file)
+        nonlocal foreign_ckpt
+        foreign_ckpt = False   # the checkpoint now describes THIS run
+        tmp = f"{npz_path}.tmp.{os.getpid()}.npz"
+        np.savez(tmp, k_next=np.int64(k_next),
+                 capacity=np.int64(capacity),
+                 last_pos=np.asarray(last_pos),
+                 hist=np.asarray(hist),
+                 head_pos=np.asarray(head_pos))
+        os.replace(tmp, npz_path)
+        jr.record({"shard_ckpt": 1}, k_next=k_next,
+                  comp=comp.snapshot(), **ident)
+
     with open(path, "rb") as f:
-        for k in range(n_calls):
+        for k in range(k0, n_calls):
             ids = np.stack([read_slice(f, d, k) for d in range(D)])
             if comp.next_free > capacity:
                 # table growth: re-pad the carries at the new capacity
@@ -1222,7 +1347,19 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
                 last_pos, hist, head_pos,
                 jax.device_put(ids.reshape(D, SB, window), sh),
             )
+            if jr is not None and k + 1 < n_calls \
+                    and (k + 1 - k0) % checkpoint_every == 0:
+                save_ckpt(k + 1)
     out = finish_call(capacity)(last_pos, hist, head_pos)
+    if jr is not None and not foreign_ckpt:
+        # a finished run retires its checkpoint (a later DIFFERENT run
+        # must not resume from this one's final state) — but never a
+        # checkpoint that belongs to SOMEONE ELSE's interrupted run
+        for p_ in (checkpoint_path, npz_path):
+            try:
+                os.unlink(p_)
+            except OSError:
+                pass
     return ReplayResult(np.asarray(out, np.int64), n, comp.next_free)
 
 

@@ -16,6 +16,11 @@ CLI_FLAGS=${PLUSS_CLI_FLAGS---cpu}
 # Diagnostics go to stderr so output.txt keeps only the diffable blocks.
 python -m pluss.cli lint --all 1>&2
 
+# schedule-aware analysis gate (placement-refined races, false sharing,
+# footprint/MRC bounds — pluss/analysis/{schedule,falseshare,footprint}):
+# still pure host analysis, ~20 s for the registry at default sizes.
+python -m pluss.cli analyze --all 1>&2
+
 # opt-in chaos smoke (PLUSS_CHAOS=1): a short seeded fault-plan soak on the
 # CPU backend — every injected fault (OOM / compile / share-cap / corrupt
 # cache) must either recover to a bit-exact result via the degradation

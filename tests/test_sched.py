@@ -41,6 +41,9 @@ CASES = [
     (7, 7, 0, 1, 2),     # single chunk
     (4, 3, 0, 1, 4),     # trip < chunk_size
     (2, 64, 0, 1, 8),    # 8 simulated threads
+    (4, 10, 0, -1, 2),   # descending loop (negative step)
+    (3, 7, 5, -2, 2),    # descending, stride 2, nonzero start
+    (4, 9, -3, -1, 4),   # descending from a negative start, partial tail
 ]
 
 
@@ -62,7 +65,7 @@ def test_thread_iterations_partition_the_loop(cs, trip, start, step, T):
     seen = []
     for tid in range(T):
         vals = s.thread_iteration_values(tid)
-        assert vals == sorted(vals)
+        assert vals == sorted(vals, reverse=step < 0)
         seen.extend(vals)
     expect = [start + i * step for i in range(trip)]
     assert sorted(seen) == sorted(expect)
@@ -106,3 +109,62 @@ def test_resume_start_point():
     for tid in range(4):
         got = s.chunks_of_thread_from(tid, 37)
         assert got == [c for c in s.chunks_of_thread(tid) if c >= 2 * 4]
+
+
+# ---------------------------------------------------------------------------
+# edge cases: empty loops (trip=0), invalid chunk ids, bad constructions
+# (previously unexercised by the analyzer — the schedule-aware passes
+# now construct schedules for arbitrary nests, including empty ones)
+# ---------------------------------------------------------------------------
+
+def test_empty_schedule_is_valid_and_empty():
+    s = ChunkSchedule(4, 0, 0, 1, 2)
+    assert s.n_chunks == 0
+    assert s.max_rounds() == 0
+    assert s.dynamic_assignment() == []
+    for tid in range(2):
+        assert s.chunks_of_thread(tid) == []
+        assert s.n_chunks_of_thread(tid) == 0
+        assert s.thread_iteration_indices(tid) == []
+        assert s.thread_iteration_values(tid) == []
+
+
+def test_empty_schedule_with_negative_step():
+    s = ChunkSchedule(3, 0, 7, -2, 4)
+    assert s.n_chunks == 0
+    assert all(s.chunks_of_thread(t) == [] for t in range(4))
+
+
+def test_chunk_ids_are_validated():
+    s = ChunkSchedule(4, 10, 0, 1, 2)   # n_chunks = 3
+    with pytest.raises(ValueError):
+        s.chunk_index_range(3)
+    with pytest.raises(ValueError):
+        s.chunk_bounds(-1)
+    # the trip=0 garbage-range regression: chunk 0 of an empty loop used
+    # to return an inverted (0, -1) value range instead of failing
+    with pytest.raises(ValueError):
+        ChunkSchedule(4, 0, 0, 1, 2).chunk_bounds(0)
+
+
+def test_constructor_rejects_nonsense():
+    with pytest.raises(ValueError):
+        ChunkSchedule(0, 8)          # chunk_size < 1
+    with pytest.raises(ValueError):
+        ChunkSchedule(4, -5)         # negative trip made n_chunks == -1
+    with pytest.raises(ValueError):
+        ChunkSchedule(4, 8, 0, 0)    # zero step
+    with pytest.raises(ValueError):
+        ChunkSchedule(4, 8, 0, 1, 0)  # no threads
+
+
+def test_negative_step_decomposition_round_trip():
+    # static_tid / local_rank / static_thread_local_pos agree with the
+    # enumerated per-thread streams on descending grids
+    for cs, trip, start, step, T in [(4, 10, 0, -1, 2), (3, 7, 5, -2, 2),
+                                     (2, 9, -3, -3, 3)]:
+        s = ChunkSchedule(cs, trip, start, step, T)
+        for tid in range(T):
+            for rank, v in enumerate(s.thread_iteration_values(tid)):
+                assert s.static_tid(v) == tid
+                assert s.local_rank(v) == rank

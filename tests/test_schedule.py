@@ -1,0 +1,173 @@
+"""Placement-refinement tests: schedule-aware race/reuse verdicts.
+
+The contract chain pinned here, per reference and per (T, chunk):
+
+    dynamically observed cross-parallel reuse
+        ⊆ schedule-REFINED static classification
+        ⊆ schedule-BLIND static classification
+
+with the left inclusion checked against the engine-equivalent oracle on
+EVERY registry model (the acceptance bar: placement-refined verdicts
+agree with the engine's dynamic share split), and exactness on the two
+models the schedule-blind test already pins exactly.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pluss import analysis, cli
+from pluss.analysis import Severity, deps, schedule
+from pluss.config import SamplerConfig
+from pluss.models import REGISTRY, gemm
+from pluss.models.polybench import syrk_triangular
+from pluss.spec import Loop, LoopNestSpec, Ref
+from tests.test_analysis import InstrumentedOracle
+
+
+def _refined_observed(spec, cfg):
+    sa = schedule.refine(spec, cfg)
+    return {sc.site.ref.name for sc in sa.classes.values() if sc.observed}
+
+
+# ---------------------------------------------------------------------------
+# refined ⊆ blind, for every registry model and several schedules
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(REGISTRY))
+def test_refined_is_subset_of_blind(name):
+    spec = REGISTRY[name](8)
+    ana = deps.analyze(spec)
+    blind_cross = {rc.site.ref.name for rc in ana.classes.values()
+                   if rc.cross_parallel}
+    blind_obs = {rc.site.ref.name for rc in ana.classes.values()
+                 if rc.cross_observed}
+    for T, CS in [(2, 2), (4, 1), (3, 4)]:
+        sa = schedule.refine(spec, SamplerConfig(thread_num=T,
+                                                 chunk_size=CS),
+                             analysis=ana)
+        for sc in sa.classes.values():
+            nm = sc.site.ref.name
+            if sc.cross_thread:
+                assert nm in blind_cross
+            if sc.observed:
+                assert nm in blind_obs
+            # refined carried level can only drop level 0, never invent it
+            rc = ana.classes[sc.site.path]
+            if sc.carried_level is not None:
+                assert rc.carried_level is not None
+                assert sc.carried_level >= rc.carried_level
+
+
+# ---------------------------------------------------------------------------
+# dynamic ⊆ refined, for EVERY registry model (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(REGISTRY))
+def test_dynamic_share_split_agrees_with_refined(name):
+    # cls == ds: element granularity, so the element-granular analysis
+    # and the line-granular dynamic accounting see the same geometry
+    spec = REGISTRY[name](8)
+    for T, CS in [(2, 2), (2, 1)]:
+        cfg = SamplerConfig(thread_num=T, chunk_size=CS, cls=8)
+        inst = InstrumentedOracle(spec, cfg).run()
+        refined = _refined_observed(spec, cfg)
+        assert inst.cross_refs <= refined, (
+            f"{name} T={T} CS={CS}: dynamically observed cross-parallel "
+            f"reuse at {inst.cross_refs - refined} refuted by the "
+            "placement-refined analysis")
+
+
+@pytest.mark.parametrize("build", [gemm, syrk_triangular],
+                         ids=["gemm", "syrk_tri"])
+def test_refined_agreement_is_exact_on_pinned_models(build):
+    spec = build(8)
+    cfg = SamplerConfig(thread_num=2, chunk_size=2, cls=8)
+    inst = InstrumentedOracle(spec, cfg).run()
+    assert inst.cross_refs == _refined_observed(spec, cfg)
+
+
+# ---------------------------------------------------------------------------
+# PL304 downgrade: the verdict flips with the schedule
+# ---------------------------------------------------------------------------
+
+def _invariant_store_spec(trip=4):
+    # every parallel iteration rewrites B[j]: a PL301 under any schedule
+    # that splits the iterations across threads, thread-private when one
+    # chunk swallows the whole loop
+    return LoopNestSpec("inv", (("B", 8),), (Loop(trip=trip, body=(
+        Loop(trip=8, body=(
+            Ref("B0", "B", addr_terms=((1, 1),), is_write=True),
+            Ref("B1", "B", addr_terms=((1, 1),), is_write=True),
+        )),
+    )),))
+
+
+def test_pl304_downgrade_when_schedule_serializes():
+    spec = _invariant_store_spec(trip=4)
+    # chunk_size 4 puts all 4 parallel iterations in chunk 0 -> thread 0
+    diags = schedule.check(spec, SamplerConfig(thread_num=2, chunk_size=4))
+    codes = {d.code for d in diags}
+    assert "PL304" in codes and "PL301" not in codes
+    pl304 = next(d for d in diags if d.code == "PL304")
+    assert pl304.severity is Severity.INFO
+    # chunk_size 1 spreads them across both threads -> the race is real
+    diags = schedule.check(spec, SamplerConfig(thread_num=2, chunk_size=1))
+    codes = {d.code for d in diags}
+    assert "PL301" in codes and "PL304" not in codes
+
+
+def test_analyze_spec_replaces_blind_race_stream():
+    spec = _invariant_store_spec(trip=4)
+    lint_codes = {d.code for d in analysis.lint_spec(spec)}
+    assert "PL301" in lint_codes
+    diags, fp = analysis.analyze_spec(
+        spec, SamplerConfig(thread_num=2, chunk_size=4))
+    codes = {d.code for d in diags}
+    assert "PL304" in codes and "PL301" not in codes
+    assert fp.total >= 1
+
+
+def test_empty_nest_is_handled():
+    spec = LoopNestSpec("empty", (("B", 8),), (Loop(trip=0, body=(
+        Ref("B0", "B", addr_terms=((0, 1),), is_write=True),
+    )),))
+    diags, fp = analysis.analyze_spec(
+        spec, SamplerConfig(thread_num=2, chunk_size=2))
+    assert not any(d.severity is Severity.ERROR for d in diags
+                   if d.code.startswith("PL3") or d.code.startswith("PL5"))
+    assert fp.accesses == 0 and fp.total == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_analyze_single_model(capsys):
+    assert cli.main(["analyze", "--model", "gemm", "--n", "16",
+                     "--threads", "2", "--chunk", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "footprint" in out and "0 error(s)" in out
+
+
+def test_cli_analyze_all(capsys):
+    assert cli.main(["analyze", "--all"]) == 0
+    out = capsys.readouterr().out
+    assert f"{len(REGISTRY)} model(s), 0 error(s)" in out
+
+
+def test_cli_analyze_json(capsys):
+    import json
+
+    assert cli.main(["analyze", "--model", "gemm", "--n", "12",
+                     "--threads", "2", "--chunk", "1", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["errors"] == 0
+    assert doc["schedule"] == {"threads": 2, "chunk": 1, "ds": 8,
+                               "cls": 64}
+    assert any(d["code"] == "PL305" for d in doc["diagnostics"])
+    fp = doc["footprint"]["gemm12"]
+    assert fp["total_lines"] == sum(fp["per_array"].values())
+    assert sum(fp["per_thread_cold"]) >= fp["total_lines"]
+    lo, hi = fp["mrc_plateau_bounds"]
+    assert 0 <= lo <= hi

@@ -315,3 +315,111 @@ def test_replay_file_deadline_truncates_cleanly(tmp_path):
     # no deadline: unchanged behavior
     full = trace.replay_file(str(p), window=window)
     assert full.total_count == n
+
+
+def test_pack_file_i32_fallback_past_2pow24_lines(tmp_path):
+    """Line tables past 2^24 ids restart the pack in the int32 wire
+    format (PR-2 follow-up: the u24 path used to raise).  The compactor's
+    slack makes the boundary cheap to cross: clusters spaced beyond the
+    slack each reserve 1024 id slots, so ~16.5K refs overflow the table."""
+    window = 1 << 9
+    n_clusters = (1 << 24) // 1024 + 64
+    lines = np.arange(n_clusters, dtype=np.int64) * 4096
+    addrs = lines * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    packed = str(tmp_path / "t.pack")
+    meta = trace.pack_file(str(p), packed, window=window)
+    assert meta["fmt"] == "i32"
+    assert meta["n_lines"] >= 1 << 24
+    assert meta["n"] == n_clusters
+    import os
+
+    assert os.path.getsize(packed) >= n_clusters * 4  # 4-byte wire records
+    res = trace.replay_resident(packed, meta, window=window)
+    assert res.total_count == n_clusters
+    # all-distinct lines: pure cold misses — and bit-identical to the
+    # streamed replay of the raw trace
+    ref = trace.replay_file(str(p), window=window)
+    np.testing.assert_array_equal(res.hist, ref.hist)
+    assert int(res.hist[0]) == n_clusters
+
+
+def test_pack_file_u24_boundary_stays_narrow(tmp_path):
+    """A table just UNDER 2^24 ids keeps the 3-byte format."""
+    window = 1 << 9
+    n_clusters = 1000            # 1000 * 1024 slots < 2^24
+    lines = np.arange(n_clusters, dtype=np.int64) * 4096
+    p = tmp_path / "t.bin"
+    (lines * 64).astype("<u8").tofile(p)
+    packed = str(tmp_path / "t.pack")
+    meta = trace.pack_file(str(p), packed, window=window)
+    assert meta["fmt"] == "u24" and meta["n_lines"] < 1 << 24
+
+
+def test_shard_replay_file_resume_checkpoint(tmp_path):
+    """Interrupted sharded replay resumes from the journal + npz
+    checkpoint bit-identically (PR-2 follow-up)."""
+    import os
+
+    rng = np.random.default_rng(17)
+    window = 1 << 8
+    n = 8 * 6 * window              # S=6 windows/segment on the 8-dev mesh
+    addrs = (rng.integers(0, 1 << 11, n, dtype=np.int64) << 6)
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    ckpt = str(tmp_path / "shard.ckpt")
+    ref = trace.replay_file(str(p), window=window)
+
+    # run once WITH checkpointing every call, interrupting mid-run by
+    # faulting a batch read of the final step call (n_calls = 3, D = 8:
+    # hit 18 lands in call k=2, after the k_next=2 checkpoint)
+    from pluss.resilience import faults
+    from pluss.resilience.errors import DataLoss
+
+    faults.install(faults.FaultPlan.parse("trace_loss@18"))
+    try:
+        with pytest.raises(DataLoss):
+            trace.shard_replay_file(str(p), window=window,
+                                    batch_windows=2, checkpoint_path=ckpt,
+                                    checkpoint_every=1)
+    finally:
+        faults.install(None)
+    assert os.path.exists(ckpt) and os.path.exists(ckpt + ".npz")
+
+    # resume completes and matches the uninterrupted replay exactly
+    res = trace.shard_replay_file(str(p), window=window, batch_windows=2,
+                                  checkpoint_path=ckpt, resume=True)
+    np.testing.assert_array_equal(res.hist, ref.hist)
+    # a finished run retires its checkpoint
+    assert not os.path.exists(ckpt) and not os.path.exists(ckpt + ".npz")
+
+
+def test_shard_replay_file_resume_rejects_other_run(tmp_path):
+    """A checkpoint for a DIFFERENT trace/shape starts fresh, never
+    splices."""
+    rng = np.random.default_rng(19)
+    window = 1 << 8
+    n = 8 * 4 * window
+    addrs = (rng.integers(0, 1 << 10, n, dtype=np.int64) << 6)
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    ckpt = str(tmp_path / "shard.ckpt")
+    # checkpoint from a different run identity (different window)
+    from pluss.resilience.journal import Journal
+
+    Journal(ckpt).record({"shard_ckpt": 1}, k_next=1, comp={},
+                         n=n, window=window * 2, cls=64,
+                         precompacted=False, D=8, SB=2, fp="deadbeef")
+    np.savez(ckpt + ".npz", k_next=np.int64(1), capacity=np.int64(16),
+             last_pos=np.zeros((8, 16)), hist=np.zeros((8, NBINS)),
+             head_pos=np.zeros((8, 16)))
+    res = trace.shard_replay_file(str(p), window=window, batch_windows=2,
+                                  checkpoint_path=ckpt, resume=True)
+    ref = trace.replay_file(str(p), window=window)
+    np.testing.assert_array_equal(res.hist, ref.hist)
+    # the foreign run's checkpoint must SURVIVE this run's retirement —
+    # its owner may still want to resume (code-review finding)
+    import os
+
+    assert os.path.exists(ckpt) and os.path.exists(ckpt + ".npz")
