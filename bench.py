@@ -179,6 +179,19 @@ def timed_reps(step, reps: int, label: str):
     return min(times), res
 
 
+def round_keep(v: float | None, nd: int) -> float | None:
+    """Round for record compactness WITHOUT erasing small magnitudes.
+
+    ``round(1.39e-14, 9) == 0.0`` destroyed the r5 error metric's
+    machine-readable value while the prose log kept it (ADVICE r5) — any
+    value whose rounding would collapse to zero is emitted unrounded, so
+    the JSON line always carries at least the information the log does."""
+    if v is None:
+        return None
+    r = round(v, nd)
+    return v if (r == 0.0 and v != 0.0) else r
+
+
 def emit(metric: str, refs: int, best_s: float, base_s: float | None,
          path: str = "", degradations: tuple = (), **extra) -> None:
     """One JSON metric line.  ``path`` names the code path measured
@@ -195,9 +208,9 @@ def emit(metric: str, refs: int, best_s: float, base_s: float | None,
         + (f" [degraded: {','.join(degradations)}]" if degradations else ""))
     print(json.dumps({
         "metric": metric,
-        "value": round(refs_per_sec, 1),
+        "value": round_keep(refs_per_sec, 1),
         "unit": "refs/s",
-        "vs_baseline": round(vs, 3) if vs is not None else None,
+        "vs_baseline": round_keep(vs, 3),
         "path": path,
         "degradations": list(degradations),
         **extra,
@@ -277,7 +290,10 @@ def bench_trace_device(n_lines: int = 4_200_000) -> None:
     The end-to-end trace metric below is gated by this image's tunneled
     h2d feed (~10-30 MB/s, varying several-fold minute to minute); this
     companion metric pins the TPU-native compute rate of the same scan so
-    the two factors are separable in the record.
+    the two factors are separable in the record.  Measures the default
+    (segmented whole-batch) kernel; PLUSS_BENCH_TRACE_AB=1 adds a second
+    line for the legacy per-window scan so the round record carries the
+    A/B directly.
     """
     import numpy as np
 
@@ -289,22 +305,30 @@ def bench_trace_device(n_lines: int = 4_200_000) -> None:
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, n_lines, batch, dtype=np.int32)
                       .reshape(B, W))
-    fn = trace._replay_fn(W, "int32")
     pdt = np.dtype("int32")
-    last = jnp.full((1 << 23,), -1, pdt)
-    hist = jnp.zeros((trace.NBINS,), pdt)
-    last, hist = fn(last, hist, pdt.type(0), ids, pdt.type(2**31 - 4))
-    np.asarray(hist[:1])  # tiny d2h forces completion (block_until_ready
-    # does not actually wait over the tunneled backend)
-    reps = 12
-    t0 = time.perf_counter()
-    for b in range(1, reps + 1):   # varying base defeats content caching
-        last, hist = fn(last, hist, pdt.type(b * batch), ids,
-                        pdt.type(2**31 - 4))
-    np.asarray(hist[:1])
-    dt = time.perf_counter() - t0
-    emit("trace_device_scan_refs_per_sec", reps * batch, dt, None,
-         path="trace_device_scan")
+
+    def measure(segmented: bool) -> tuple[int, float]:
+        fn = trace._replay_fn(W, "int32", segmented=segmented)
+        last = jnp.full((1 << 23,), -1, pdt)
+        hist = jnp.zeros((trace.NBINS,), pdt)
+        last, hist = fn(last, hist, pdt.type(0), ids, pdt.type(2**31 - 4))
+        np.asarray(hist[:1])  # tiny d2h forces completion (block_until_ready
+        # does not actually wait over the tunneled backend)
+        reps = 12
+        t0 = time.perf_counter()
+        for b in range(1, reps + 1):   # varying base defeats content caching
+            last, hist = fn(last, hist, pdt.type(b * batch), ids,
+                            pdt.type(2**31 - 4))
+        np.asarray(hist[:1])
+        return reps * batch, time.perf_counter() - t0
+
+    refs, dt = measure(True)
+    emit("trace_device_scan_refs_per_sec", refs, dt, None,
+         path="trace_device_scan(segmented)", batch_windows=B)
+    if os.environ.get("PLUSS_BENCH_TRACE_AB"):
+        refs, dt = measure(False)
+        emit("trace_device_scan_legacy_refs_per_sec", refs, dt, None,
+             path="trace_device_scan(per-window scan)", batch_windows=B)
 
 
 def ensure_trace(n_refs: int) -> str:

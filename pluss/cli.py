@@ -231,6 +231,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--share-cap", type=int, default=SHARE_CAP)
     p.add_argument("--window", type=int, default=None,
                    help="scan-window size override (accesses per window)")
+    p.add_argument("--batch-windows", type=int, default=None,
+                   help="trace mode: windows per device batch (default "
+                        "from PLUSS_BATCH_WINDOWS or 16) — one segmented "
+                        "sort-kernel dispatch covers the whole batch, so "
+                        "bigger batches amortize dispatch cost; part of "
+                        "the checkpoint identity")
     p.add_argument("--start-point", type=int, default=None,
                    help="resume sampling from this parallel-loop iteration "
                         "value (the reference's setStartPoint capability)")
@@ -380,6 +386,11 @@ def main(argv: list[str] | None = None) -> int:
         # (which merely contains "shard") must not select it
         t0 = time.perf_counter()
         win = args.window or trace_mod.TRACE_WINDOW
+        # None defers to the module default (PLUSS_BATCH_WINDOWS env or 16);
+        # explicit values — including invalid ones — pass through so the
+        # trace layer's validation rejects them loudly
+        bw_kw = {"batch_windows": args.batch_windows} \
+            if args.batch_windows is not None else {}
         if backends_explicit and backends != ["shard"]:
             # an explicit backend choice other than exactly 'shard' is
             # silently a no-op here — say so (mirrors the --window notice)
@@ -400,6 +411,9 @@ def main(argv: list[str] | None = None) -> int:
                 if args.resume or args.journal:
                     print("pluss: --resume/--journal have no effect on "
                           "multi-process sharded replay", file=sys.stderr)
+                if args.batch_windows is not None:
+                    print("pluss: --batch-windows has no effect on the "
+                          "in-memory sharded replay", file=sys.stderr)
                 rep = trace_mod.shard_replay(
                     trace_mod.load_trace(args.file, args.fmt),
                     cls=cfg.cls, window=win)
@@ -415,12 +429,15 @@ def main(argv: list[str] | None = None) -> int:
                           file=sys.stderr)
                 rep = trace_mod.shard_replay_file(
                     args.file, cls=cfg.cls, window=win,
-                    checkpoint_path=ckpt, resume=args.resume)
+                    checkpoint_path=ckpt, resume=args.resume, **bw_kw)
             else:
                 if args.resume or args.journal:
                     print("pluss: --resume/--journal have no effect on "
                           f"sharded {args.fmt} traces (checkpointing is "
                           "u64-only)", file=sys.stderr)
+                if args.batch_windows is not None:
+                    print("pluss: --batch-windows has no effect on the "
+                          "in-memory sharded replay", file=sys.stderr)
                 rep = trace_mod.shard_replay(
                     trace_mod.load_trace(args.file, args.fmt),
                     cls=cfg.cls, window=win)
@@ -438,7 +455,7 @@ def main(argv: list[str] | None = None) -> int:
                       file=sys.stderr)
             rep = replay_file_resilient(args.file, args.fmt, cls=cfg.cls,
                                         window=win, checkpoint_path=ckpt,
-                                        resume=args.resume)
+                                        resume=args.resume, **bw_kw)
         dt = time.perf_counter() - t0
         if getattr(rep, "degradations", ()):
             # stderr: the stdout block format is diffed byte-for-byte

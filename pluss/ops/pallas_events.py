@@ -67,7 +67,9 @@ def _kernel(key_ref, prev_key_ref, pos_ref, prev_pos_ref, span_ref,
     oh = (bins[:, :, None] == ids).astype(jnp.float32)
     # per-block counts are exact in f32 (<= BLOCK < 2^24); the CROSS-block
     # accumulator is int32 so totals stay exact past 2^24 (the XLA path's
-    # bin_histogram falls back to segment_sum there — match its contract)
+    # bin_histogram keeps the same contract by chunking its one-hot
+    # matmuls and accumulating the exact per-chunk results in the integer
+    # weight dtype — pluss/ops/reuse.py bin_histogram)
     local = jnp.sum(oh * wgt[:, :, None],
                     axis=(0, 1))[None, :].astype(jnp.int32)
 

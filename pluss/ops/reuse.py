@@ -89,6 +89,32 @@ def sort_stream(line, pos, span, valid, pos_sorted: bool = False):
     return key_s, pos_s, span_s, valid_s
 
 
+def batch_events(line, pos, valid, last_pos, span=None):
+    """Whole-batch segmented reuse extraction: ONE sort, ONE carried gather,
+    ONE tail scatter for an arbitrarily large stream slice.
+
+    This is the PARDA/SHARDS-style decomposition (Niu et al.; Waldspurger
+    et al.): instead of scanning a batch as ``n/window`` dependent windows
+    (a device dependency chain), sort the entire slice by ``(line, pos)``
+    at once — ``pos`` MUST arrive in ascending stream order, so a single
+    *stable* sort on the line key alone realizes the two-key order — and
+    every intra-batch reuse interval is a segment-internal position diff,
+    all computed in one vectorized subtraction.  The persistent
+    ``last_pos`` table is touched once per batch: one (sorted-index)
+    gather resolves segment heads, one permutation scatter writes segment
+    tails; only the first/last occurrence per distinct line takes effect.
+
+    Exposed as a standalone primitive so the trace replay path
+    (:mod:`pluss.trace`) and the engine's ultra-window path can share it.
+    Returns ``(ev, new_last_pos)`` exactly like :func:`window_events` —
+    and is bit-identical to scanning the same slice window-by-window,
+    because reuse intervals are pairwise same-line gaps, invariant under
+    how the stream is partitioned.
+    """
+    return window_events(
+        *sort_stream(line, pos, span, valid, pos_sorted=True), last_pos)
+
+
 def window_events(key_s, pos_s, span_s, valid_i, last_pos):
     """Reuse events of one sorted window, resolved against carried state.
 
@@ -235,12 +261,24 @@ def bin_histogram(bins: jnp.ndarray, wgt: jnp.ndarray,
 
     TPUs serialize dynamic-index scatters, so ``segment_sum`` over a window is
     orders of magnitude slower than a [1, n] x [n, num_segments] matmul.  f32
-    accumulation is exact while a window holds < 2^24 events; the engine's
-    window sizes guarantee that (engine.WINDOW_TARGET).
+    accumulation is exact while one matmul holds < 2^24 events; streams past
+    2^23 are statically chunked and the per-chunk (exact) results accumulate
+    in the integer weight dtype — so the MXU path stays exact at ANY length
+    (the whole-batch trace kernel feeds multi-window slices through here).
     """
     n = bins.shape[0]
-    if n >= 1 << 24:  # f32 mantissa bound; fall back to the exact scatter
-        return jax.ops.segment_sum(wgt, bins, num_segments=num_segments)
+    lim = 1 << 23  # engine windows cap here (WINDOW_TARGET): single matmul
+    if n > lim:
+        # chunk small (2^20, not 2^23): each chunk's f32 one-hot sum stays
+        # < 2^24 (exact) either way, but the [chunk, num_segments] one-hot
+        # operand is the peak intermediate — 2^20 rows keeps it at the
+        # size the engine's own windows already materialize
+        step = 1 << 20
+        out = jnp.zeros((num_segments,), wgt.dtype)
+        for lo in range(0, n, step):
+            out = out + bin_histogram(bins[lo:lo + step], wgt[lo:lo + step],
+                                      num_segments)
+        return out
     oh = bins[:, None] == jnp.arange(num_segments, dtype=bins.dtype)[None, :]
     out = wgt.astype(jnp.float32)[None, :] @ oh.astype(jnp.float32)
     return out[0].astype(wgt.dtype)

@@ -16,9 +16,16 @@ affine enumeration:
    space; only cluster MISSES are ever sorted) — the TPU equivalent of the
    reference's unbounded ``unordered_map`` LAT over raw lines, in bounded
    memory.
-2. Device scan: ``lax.scan`` over fixed-size windows carrying
-   ``last_pos[line]`` + the dense histogram, identical to the static path —
-   arbitrarily long streams in bounded device memory (donated carry).
+2. Device kernel: the whole ``[batch_windows * window]`` batch is one
+   segmented sort-based reuse extraction (:func:`pluss.ops.reuse.batch_events`
+   — one stable key sort, one carried gather, one tail scatter, PARDA/SHARDS
+   style) carrying ``last_pos[line]`` + the dense histogram across batches —
+   arbitrarily long streams in bounded device memory (donated carry).  The
+   pre-round-6 per-window ``lax.scan`` formulation stays the default on
+   the CPU backend (where the single-threaded big sort loses) and remains
+   available everywhere via ``segmented=False`` / ``PLUSS_TRACE_SEGMENTED``
+   for A/B verification (bit-identical histograms by construction;
+   asserted by the property suite, tests/test_trace_property.py).
 
 A replayed trace is single-clock (one logical time per access, the reference's
 ``pluss_access`` semantics), so the result feeds :func:`pluss.mrc.aet_mrc`
@@ -30,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +45,7 @@ import numpy as np
 
 from pluss.config import NBINS
 from pluss.ops.reuse import (
+    batch_events,
     bin_histogram,
     event_histogram,
     log2_bin,
@@ -81,9 +90,64 @@ class ReplayResult:
         return out
 
 
-#: windows shipped to the device per batch; one compile serves a trace of any
-#: length because every batch has the same [WINDOWS_PER_BATCH, window] shape
-WINDOWS_PER_BATCH = 8
+def _env_int(name: str, default: int, minimum: int = 1) -> int:
+    """An integer env knob, parsed leniently: a malformed or out-of-range
+    value must not crash an import or abort an hours-long replay mid-run —
+    warn (naming the env var, so the operator knows where to act) and
+    fall back to the default instead.  Explicit kwargs keep their loud
+    validation at the use sites (:func:`_resolve_bw`, the queue-depth
+    check)."""
+    raw = os.environ.get(name, "")
+    if raw.strip():
+        import sys
+
+        try:
+            v = int(raw)
+        except ValueError:
+            print(f"trace: ignoring malformed {name}={raw!r}; "
+                  f"using the default {default}", file=sys.stderr)
+            return default
+        if v < minimum:
+            print(f"trace: ignoring out-of-range {name}={raw!r} (must be "
+                  f">= {minimum}); using the default {default}",
+                  file=sys.stderr)
+            return default
+        return v
+    return default
+
+
+#: default windows shipped to the device per batch; one compile serves a
+#: trace of any length because every batch has the same
+#: [batch_windows, window] shape.  Raised 8 -> 16 with the segmented batch
+#: kernel (one sort per batch means bigger batches amortize dispatch +
+#: table-touch cost instead of lengthening a scan chain).  Overridable
+#: per-process via PLUSS_BATCH_WINDOWS, per-call via the ``batch_windows``
+#: kwarg, and on the CLI via ``pluss trace --batch-windows``.
+WINDOWS_PER_BATCH = _env_int("PLUSS_BATCH_WINDOWS", 16)
+
+
+def _resolve_bw(batch_windows: int | None) -> int:
+    """The effective windows-per-batch, validated.  A non-positive value
+    must fail loudly here: ``batch_windows=-4`` would otherwise return an
+    all-zero histogram that still claims full coverage (zero batches
+    dispatched), and 0 would silently alias the default."""
+    bw = WINDOWS_PER_BATCH if batch_windows is None else int(batch_windows)
+    if bw < 1:
+        raise ValueError(f"batch_windows must be >= 1, got {bw}")
+    return bw
+
+
+def _segmented_default() -> bool:
+    """Whole-batch segmented kernel by default on accelerators, where one
+    big parallel sort beats a serial window chain; on the CPU backend the
+    legacy per-window scan stays the default (the single-threaded sort
+    makes segmented ~1.3x slower there — PARITY.md round-6 A/B).
+    PLUSS_TRACE_SEGMENTED overrides either way (=1 forces segmented on
+    CPU, =0 forces the scan on an accelerator)."""
+    env = os.environ.get("PLUSS_TRACE_SEGMENTED")
+    if env is not None:
+        return env.lower() not in ("0", "false", "off", "")
+    return jax.default_backend() != "cpu"
 
 
 class _threaded:
@@ -153,13 +217,14 @@ def _pack24(ids: np.ndarray) -> np.ndarray:
     transfer-bound end-to-end (device compute is ~25x faster than the
     feed); shipping 3 bytes/ref instead of 4 is a direct 4/3 speedup.
     The device widens the bytes back in :func:`_replay_fn` — negligible
-    next to the window sort.
+    next to the batch sort.  Vectorized as one little-endian int32
+    reinterpret + a single strided copy dropping the high byte: two passes
+    over the data instead of three masked shift/store passes — the pack
+    runs on the host core shared with the PJRT client and must never gate
+    the overlapped h2d feed.
     """
-    out = np.empty((len(ids), 3), np.uint8)
-    out[:, 0] = ids & 0xFF
-    out[:, 1] = (ids >> 8) & 0xFF
-    out[:, 2] = (ids >> 16) & 0xFF
-    return out
+    b4 = np.ascontiguousarray(ids, dtype="<i4").view(np.uint8)
+    return np.ascontiguousarray(b4.reshape(-1, 4)[:, :3])
 
 
 def _pack16(ids: np.ndarray) -> np.ndarray:
@@ -193,31 +258,40 @@ def _widen_ids(line_w):
     return line_w
 
 
-def _replay_fn(window: int, pos_dtype_name: str):
-    """Batched replay step.  Not keyed by the line-table size: ``jit``
-    retraces on a new ``last_pos`` shape, which is exactly what the
-    streaming path's geometric table growth needs."""
+def _replay_fn(window: int, pos_dtype_name: str,
+               segmented: bool | None = None):
+    """Batched replay step.  Not keyed by the line-table size OR the batch
+    width: ``jit`` retraces on a new ``last_pos`` / ids shape, which is
+    exactly what the streaming path's geometric table growth (and a
+    ``--batch-windows`` override) needs."""
+    if segmented is None:
+        segmented = _segmented_default()
     # the donation decision is backend-dependent, so the backend is part of
     # the cache key — a force_cpu fallback after an accelerator run must not
     # reuse a donating executable (and vice versa)
-    return _replay_fn_cached(window, pos_dtype_name, jax.default_backend())
+    return _replay_fn_cached(window, pos_dtype_name, jax.default_backend(),
+                             bool(segmented))
 
 
 def _scan_batch(last_pos, hist, base, ids, n_valid, window: int, pdt):
-    """Trace the scan of one [WINDOWS_PER_BATCH, window] id batch.
+    """LEGACY per-window scan of one [batch_windows, window] id batch.
 
-    ids: int32, or [.., window, 3] uint8 (24-bit packed) or uint16
-    (_pack_ids — the h2d feed is the bottleneck); base: batch stream
-    offset; n_valid: total stream length — padding is always the stream
-    tail, so validity is just pos < n_valid (a scalar ships per batch
-    instead of a [batch] bool array: on a 1-core host the numpy staging of
-    big transfers starves the PJRT client thread and serializes the pipe).
-    Shared by the streamed (:func:`_replay_fn`) and device-resident
-    (:func:`replay_resident`) paths.
+    ids: int32, or [.., window, 3] uint8 (24-bit packed) or [.., window, 4]
+    uint8 (LE int32 wire) or uint16 (_pack_ids — the h2d feed is the
+    bottleneck); base: batch stream offset; n_valid: total stream length —
+    padding is always the stream tail, so validity is just pos < n_valid
+    (a scalar ships per batch instead of a [batch] bool array: on a 1-core
+    host the numpy staging of big transfers starves the PJRT client thread
+    and serializes the pipe).
+
+    Kept behind ``segmented=False`` as the A/B reference for
+    :func:`_segmented_batch`: the scan serializes the device into an
+    n/window dependency chain, which is why it lost to the native replay
+    end-to-end (r05: 0.34x) and was replaced as the default.
     """
     pos = (
         base
-        + jnp.arange(WINDOWS_PER_BATCH, dtype=pdt)[:, None] * window
+        + jnp.arange(ids.shape[0], dtype=pdt)[:, None] * window
         + jnp.arange(window, dtype=pdt)[None, :]
     )
     valid = pos < n_valid
@@ -225,7 +299,7 @@ def _scan_batch(last_pos, hist, base, ids, n_valid, window: int, pdt):
     def step(carry, xs):
         last_pos, hist = carry
         line_w, pos_w, valid_w = xs
-        line_w = _widen_ids(line_w)   # u8[n,3] / u16 packed feeds
+        line_w = _widen_ids(line_w)   # u8[n,3|4] / u16 packed feeds
         # trace windows arrive in stream order: stable single-key sort,
         # no span payload (the trace path has no share classification)
         ev, last_pos = window_events(
@@ -240,11 +314,35 @@ def _scan_batch(last_pos, hist, base, ids, n_valid, window: int, pdt):
     return last_pos, hist
 
 
-@functools.lru_cache(maxsize=16)
-def _replay_fn_cached(window: int, pos_dtype_name: str, backend: str):
+def _segmented_batch(last_pos, hist, base, ids, n_valid, pdt):
+    """Whole-batch segmented reuse kernel (the default since round 6).
+
+    The entire [batch_windows, window] batch is flattened and processed as
+    ONE :func:`pluss.ops.reuse.batch_events` call: positions are the
+    stream order itself, so a single stable key sort realizes the
+    (line, pos) order, every intra-batch reuse is a segment-internal
+    position diff computed in parallel, and the persistent ``last_pos``
+    table is touched once — one gather resolving first-occurrence heads,
+    one scatter writing last-occurrence tails.  The cross-batch dependency
+    chain collapses from n/window scan steps to n_batches gather/scatters.
+    Bit-identical to :func:`_scan_batch` (reuse gaps are partition-
+    invariant; histogram accumulation is integer-exact on both paths).
+    """
+    flat = ids.reshape((ids.shape[0] * ids.shape[1],) + ids.shape[2:])
+    line = _widen_ids(flat)           # u8[n,3|4] / u16 packed feeds
+    pos = base + jnp.arange(flat.shape[0], dtype=pdt)
+    ev, last_pos = batch_events(line, pos, pos < n_valid, last_pos)
+    return last_pos, hist + event_histogram(ev)
+
+
+@functools.lru_cache(maxsize=32)
+def _replay_fn_cached(window: int, pos_dtype_name: str, backend: str,
+                      segmented: bool):
     pdt = jnp.dtype(pos_dtype_name)
 
     def run(last_pos, hist, base, ids, n_valid):
+        if segmented:
+            return _segmented_batch(last_pos, hist, base, ids, n_valid, pdt)
         return _scan_batch(last_pos, hist, base, ids, n_valid, window, pdt)
 
     # donating the carry keeps last_pos/hist in place on device across
@@ -255,11 +353,14 @@ def _replay_fn_cached(window: int, pos_dtype_name: str, backend: str):
 
 
 def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
-           precompacted: bool = False) -> ReplayResult:
+           precompacted: bool = False, batch_windows: int | None = None,
+           segmented: bool | None = None) -> ReplayResult:
     """Replay a raw address stream into a reuse histogram.
 
     ``addrs``: 1-D array of byte addresses (or dense line ids when
     ``precompacted`` — e.g. synthetic workloads that already index lines).
+    ``batch_windows``/``segmented`` default to the module/env settings
+    (:data:`WINDOWS_PER_BATCH`, :func:`_segmented_default`).
     """
     addrs = np.asarray(addrs)
     if addrs.ndim != 1:
@@ -269,7 +370,7 @@ def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
         return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)
     lines = addrs.astype(np.int64) if precompacted else lines_of(addrs, cls)
     ids, n_lines = _compact(lines, window)
-    return _replay_ids(ids, n_lines, n, window)
+    return _replay_ids(ids, n_lines, n, window, batch_windows, segmented)
 
 
 def _compact(lines: np.ndarray, window: int) -> tuple[np.ndarray, int]:
@@ -394,17 +495,20 @@ class _Compactor:
         return out
 
 
-def _replay_ids(ids: np.ndarray, n_lines: int, n: int,
-                window: int) -> ReplayResult:
-    """Stream dense line ids through the device scan in fixed-shape batches."""
-    batch = WINDOWS_PER_BATCH * window
+def _replay_ids(ids: np.ndarray, n_lines: int, n: int, window: int,
+                batch_windows: int | None = None,
+                segmented: bool | None = None) -> ReplayResult:
+    """Stream dense line ids through the device kernel in fixed-shape
+    batches."""
+    bw = _resolve_bw(batch_windows)
+    batch = bw * window
     n_batches = -(-n // batch)
     pos_dtype = "int32" if n_batches * batch < 2**31 - 2 else "int64"
     if pos_dtype == "int64" and not jax.config.jax_enable_x64:
         raise RuntimeError(
             f"trace of {n} accesses needs int64 positions; enable jax_enable_x64"
         )
-    fn = _replay_fn(window, pos_dtype)
+    fn = _replay_fn(window, pos_dtype, segmented)
     pdt = np.dtype(pos_dtype)
     last_pos = jnp.full((n_lines,), -1, pdt)
     hist = jnp.zeros((NBINS,), pdt)
@@ -415,7 +519,7 @@ def _replay_ids(ids: np.ndarray, n_lines: int, n: int,
         if pad:
             chunk = np.concatenate([chunk, np.zeros(pad, np.int32)])
         chunk = _pack_ids(chunk, n_lines)   # u16 / 24-bit packed feed
-        shaped = chunk.reshape((WINDOWS_PER_BATCH, window) + chunk.shape[1:])
+        shaped = chunk.reshape((bw, window) + chunk.shape[1:])
         last_pos, hist = fn(
             last_pos, hist, pdt.type(lo), jnp.asarray(shaped),
             pdt.type(n),
@@ -440,20 +544,33 @@ def _trace_fingerprint(path: str) -> str:
 
 def _ckpt_save(path: str, b_next: int, n: int, window: int, cls: int,
                precompacted: bool, fp: str, last_pos, hist,
-               comp_snap: dict) -> None:
+               comp_snap: dict, batch_windows: int) -> None:
     """Atomic replay checkpoint: everything a resumed run needs to continue
     bit-identically (device carries + compactor id table + position), plus
-    the FULL run identity — (n, window, cls, precompacted) all change the
-    compaction/scan semantics and ``fp`` binds the source file's content,
-    so a mismatch on any of them must start fresh, never splice."""
+    the FULL run identity — (n, window, cls, precompacted, batch_windows)
+    all change the compaction/batching semantics and ``fp`` binds the
+    source file's content, so a mismatch on any of them must start fresh,
+    never splice.
+
+    Only the LIVE prefix of ``last_pos`` (the compactor's ``next_free``
+    slots) is d2h-fetched and written — every slot past it is still the
+    initial -1 (ids are always < next_free), so the padding is
+    reconstructed on load instead of round-tripping a mostly-empty
+    ``capacity``-sized array through the tunnel and the disk."""
     import json
-    import os
 
     tmp = f"{path}.tmp.{os.getpid()}.npz"
+    capacity = int(last_pos.shape[0])
+    live = min(int(comp_snap["next_free"]), capacity)
+    # slice ON DEVICE before the d2h fetch: only the live prefix crosses
+    # the (tunneled, tens-of-MB/s) transport, not the whole padded table
     np.savez(tmp,
-             last_pos=np.asarray(last_pos), hist=np.asarray(hist),
+             last_pos=np.asarray(last_pos[:live]),
+             capacity=np.int64(capacity),
+             hist=np.asarray(hist),
              b_next=np.int64(b_next), n=np.int64(n),
              window=np.int64(window), cls=np.int64(cls),
+             bw=np.int64(batch_windows),
              precompacted=np.int64(bool(precompacted)),
              fp=np.frombuffer(fp.encode(), np.uint8),
              comp=np.frombuffer(json.dumps(comp_snap).encode(), np.uint8))
@@ -461,27 +578,39 @@ def _ckpt_save(path: str, b_next: int, n: int, window: int, cls: int,
 
 
 def _ckpt_load(path: str, n: int, window: int, cls: int,
-               precompacted: bool, fp: str):
+               precompacted: bool, fp: str, batch_windows: int):
     """(b_next, last_pos, hist, comp) from a checkpoint, or None when the
-    checkpoint is absent or describes a different run identity."""
+    checkpoint is absent or describes a different run identity.  The
+    ``last_pos`` carry is reconstructed at full capacity from the saved
+    live prefix (see :func:`_ckpt_save`)."""
     import json
-    import os
     import sys
 
     if not os.path.exists(path):
         return None
     try:
         with np.load(path) as z:
+            if "bw" not in z.files or "capacity" not in z.files:
+                print(f"trace: checkpoint {path} is from an older layout; "
+                      "starting fresh", file=sys.stderr)
+                return None
             ident = (int(z["n"]), int(z["window"]), int(z["cls"]),
-                     int(z["precompacted"]), bytes(z["fp"]).decode())
-            if ident != (n, window, cls, int(bool(precompacted)), fp):
+                     int(z["precompacted"]), bytes(z["fp"]).decode(),
+                     int(z["bw"]))
+            if ident != (n, window, cls, int(bool(precompacted)), fp,
+                         batch_windows):
                 print(f"trace: checkpoint {path} is for a different run "
-                      f"(n, window, cls, precompacted, file)={ident}; "
+                      f"(n, window, cls, precompacted, file, bw)={ident}; "
                       "starting fresh", file=sys.stderr)
                 return None
             comp = _Compactor.restore(
                 json.loads(bytes(z["comp"]).decode()))
-            return int(z["b_next"]), z["last_pos"], z["hist"], comp
+            lp = z["last_pos"]
+            cap = int(z["capacity"])
+            if lp.shape[0] < cap:   # re-pad the saved live prefix
+                lp = np.concatenate(
+                    [lp, np.full((cap - lp.shape[0],), -1, lp.dtype)])
+            return int(z["b_next"]), lp, z["hist"], comp
     except Exception as e:
         # same policy as the plan cache: quarantine the bad bytes and
         # start fresh — the source trace is intact, so a corrupt
@@ -501,16 +630,35 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                 deadline_s: float | None = None,
                 checkpoint_path: str | None = None,
                 checkpoint_every: int = 16,
-                resume: bool = False) -> ReplayResult:
+                resume: bool = False,
+                batch_windows: int | None = None,
+                queue_depth: int | None = None,
+                segmented: bool | None = None) -> ReplayResult:
     """Replay a trace FILE in bounded host memory (BASELINE config 5 scale).
 
     Unlike ``replay(load_trace(path))``, which slurps the whole file, this
-    streams disk batches (``WINDOWS_PER_BATCH * window`` addresses ≈ 64 MB
-    at the default window) through the incremental compactor straight into
-    the device scan, so a 1e9-ref / 8 GB trace replays without ever holding
-    more than one batch on the host.  The device line table starts at
-    ``initial_capacity`` ids and doubles as the compactor discovers the
-    working set (each growth retraces the jitted step — O(log) growths).
+    streams disk batches (``batch_windows * window`` addresses ≈ 128 MB at
+    the defaults) through the incremental compactor straight into the
+    device kernel, so a 1e9-ref / 8 GB trace replays without ever holding
+    more than a couple of batches on the host.  The device line table
+    starts at ``initial_capacity`` ids and doubles as the compactor
+    discovers the working set (each growth retraces the jitted step —
+    O(log) growths).
+
+    The feed is DOUBLE-BUFFERED: batch ``b+1``'s ``device_put`` is
+    dispatched while batch ``b``'s kernel runs, so the h2d transfer and
+    the device compute overlap instead of paying upload + scan serially
+    (the whole point of the segmented kernel — one dispatch per batch —
+    is that the pipe has exactly one compute stage to hide behind).
+
+    ``batch_windows``: windows per device batch (default
+    :data:`WINDOWS_PER_BATCH`); part of the checkpoint identity.
+    ``queue_depth``: reader-thread queue bound (default
+    ``PLUSS_TRACE_QUEUE_DEPTH`` env or 2) — deeper queues absorb burstier
+    disk/compaction latency at the cost of more in-flight host batches.
+    ``segmented``: kernel selector for A/B verification (default:
+    backend-aware — segmented on accelerators, the legacy per-window scan
+    on CPU; ``PLUSS_TRACE_SEGMENTED`` overrides either way).
 
     ``deadline_s``: optional wall clock cap — the batch loop stops cleanly
     after the batch in flight when exceeded, returning the refs actually
@@ -529,7 +677,8 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     """
     if fmt == "text":  # line-oriented; no random access worth streaming
         return replay(load_trace(path, fmt), cls, window,
-                      precompacted=precompacted)
+                      precompacted=precompacted,
+                      batch_windows=batch_windows, segmented=segmented)
     if fmt != "u64":
         raise ValueError(f"unknown trace format {fmt!r}")
     n = _u64_count(path)
@@ -540,20 +689,21 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     if cls & (cls - 1):
         raise ValueError(f"cache line size {cls} is not a power of two")
     shift = int(cls).bit_length() - 1
-    batch = WINDOWS_PER_BATCH * window
+    bw = _resolve_bw(batch_windows)
+    batch = bw * window
     n_batches = -(-n // batch)
     pos_dtype = "int32" if n_batches * batch < 2**31 - 2 else "int64"
     if pos_dtype == "int64" and not jax.config.jax_enable_x64:
         raise RuntimeError(
             f"trace of {n} accesses needs int64 positions; enable jax_enable_x64"
         )
-    fn = _replay_fn(window, pos_dtype)
+    fn = _replay_fn(window, pos_dtype, segmented)
     pdt = np.dtype(pos_dtype)
 
     b0 = 0
     comp0 = _Compactor()
     fp = _trace_fingerprint(path) if checkpoint_path else ""
-    ck = _ckpt_load(checkpoint_path, n, window, cls, precompacted, fp) \
+    ck = _ckpt_load(checkpoint_path, n, window, cls, precompacted, fp, bw) \
         if resume and checkpoint_path else None
     if ck is not None:
         b0, ck_last_pos, ck_hist, comp0 = ck
@@ -601,13 +751,19 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     # (stateful, hence single-threaded) compactor while the main thread
     # stages/dispatches to the device — the disk+compaction+packing latency
     # hides behind the previous batch's transfer and scan.  The queue bound
-    # keeps host memory at ~2 in-flight batches; numpy IO and the native
-    # compactor pass release the GIL, so the overlap is real even on one
-    # core.  ``pipeline=False`` runs the same generator inline (debugging /
-    # A-B measurement).
+    # keeps host memory at ~queue_depth in-flight batches; numpy IO and the
+    # native compactor pass release the GIL, so the overlap is real even on
+    # one core.  ``pipeline=False`` runs the same generator inline
+    # (debugging / A-B measurement).
     import contextlib
 
-    src = _threaded(batches) if pipeline else \
+    qd = queue_depth if queue_depth is not None else \
+        _env_int("PLUSS_TRACE_QUEUE_DEPTH", 2)
+    if qd < 1:
+        # queue.Queue(maxsize=0) means UNBOUNDED — the reader would buffer
+        # the whole trace and break the bounded-host-memory contract
+        raise ValueError(f"queue_depth must be >= 1, got {qd}")
+    src = _threaded(batches, depth=qd) if pipeline else \
         contextlib.nullcontext(batches())
     import time as _time
 
@@ -624,8 +780,24 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
         hist = jnp.zeros((NBINS,), pdt)
         n_lines = 0
         done = 0
+
+    def stage(item):
+        """Start one packed batch's h2d transfer NOW.  ``device_put`` is
+        async, so calling this right after dispatching the PREVIOUS
+        batch's kernel double-buffers the feed: upload b+1 overlaps
+        compute b, and at most two batches are in flight on the device."""
+        if item is None:
+            return None
+        ids, n_lines_b, snap_b = item
+        shaped = ids.reshape((bw, window) + ids.shape[1:])
+        return jax.device_put(shaped), n_lines_b, snap_b
+
     with src as it:
-        for b, (ids, n_lines, snap) in enumerate(it, start=b0):
+        stream = iter(it)
+        nxt = stage(next(stream, None))
+        b = b0
+        while nxt is not None:
+            ids_dev, n_lines, snap = nxt
             if n_lines > capacity:
                 while capacity < n_lines:
                     capacity *= 2
@@ -633,19 +805,18 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                     [last_pos, jnp.full((capacity - last_pos.shape[0],),
                                         -1, pdt)]
                 )
-            shaped = ids.reshape(
-                (WINDOWS_PER_BATCH, window) + ids.shape[1:])
             last_pos, hist = fn(
-                last_pos, hist, pdt.type(b * batch), jnp.asarray(shaped),
-                pdt.type(n),
+                last_pos, hist, pdt.type(b * batch), ids_dev, pdt.type(n),
             )
             done = min(n, (b + 1) * batch)
             if checkpoint_path and done < n \
                     and (b + 1 - b0) % checkpoint_every == 0:
                 # the d2h fetch synchronizes the dispatch queue — that is
-                # the price of a durable point; checkpoint_every amortizes
+                # the price of a durable point; checkpoint_every amortizes.
+                # The save runs BEFORE the next prefetch: a reader fault
+                # in batch b+1 must never cost batch b's durable point
                 _ckpt_save(checkpoint_path, b + 1, n, window, cls,
-                           precompacted, fp, last_pos, hist, snap)
+                           precompacted, fp, last_pos, hist, snap, bw)
             # the cheap unsynced clock runs every batch; the device sync
             # (which is what makes the elapsed time REAL under async
             # dispatch) is only paid once the unsynced time is already
@@ -658,9 +829,16 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                     # truncation is clean at a batch boundary: every
                     # processed position is < done, none beyond dispatched
                     break
+            # double buffering: the NEXT batch's device_put is dispatched
+            # while this batch's kernel runs (dispatch above is async; the
+            # checkpoint branch is a no-op on all but every
+            # checkpoint_every-th batch), so the h2d feed and the scan
+            # overlap instead of being paid serially.  A dropped in-flight
+            # prefetch at a deadline break is harmless — it never
+            # dispatches compute
+            nxt = stage(next(stream, None))
+            b += 1
     if checkpoint_path and done >= n:
-        import os
-
         # a finished run retires its checkpoint: a later DIFFERENT run
         # must not resume from this one's final state
         try:
@@ -673,7 +851,8 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
 def pack_file(path: str, out_path: str, cls: int = 64,
               window: int = TRACE_WINDOW, precompacted: bool = False,
               limit_refs: int | None = None,
-              resume: bool = False, _wide: bool = False) -> dict:
+              resume: bool = False, _wide: bool = False,
+              batch_windows: int | None = None) -> dict:
     """Compact + pack a raw u64 trace ONCE, writing the replay wire format.
 
     Streams the trace through the same incremental compactor as
@@ -699,7 +878,6 @@ def pack_file(path: str, out_path: str, cls: int = 64,
     the wire format, so a resumed i32 pack stays i32.
     """
     import json
-    import os
 
     from pluss.resilience import faults
     from pluss.resilience.journal import Journal
@@ -710,7 +888,8 @@ def pack_file(path: str, out_path: str, cls: int = 64,
     if cls & (cls - 1):
         raise ValueError(f"cache line size {cls} is not a power of two")
     shift = int(cls).bit_length() - 1
-    batch = WINDOWS_PER_BATCH * window
+    bw = _resolve_bw(batch_windows)
+    batch = bw * window
     n_batches = -(-n // batch)
     comp = _Compactor()
     tmp = out_path + ".tmp"
@@ -724,12 +903,16 @@ def pack_file(path: str, out_path: str, cls: int = 64,
             # the crashed pack had already fallen back to the wide wire
             # format; resume in it instead of re-deciding from scratch
             return pack_file(path, out_path, cls, window, precompacted,
-                            limit_refs, resume=True, _wide=True)
+                            limit_refs, resume=True, _wide=True,
+                            batch_windows=bw)
     if resume and os.path.exists(jpath) and os.path.exists(tmp):
         jr = Journal(jpath)
         best = None
+        # bw is part of the identity: journal "batch" indices count
+        # bw-sized batches, so a resumed pack must slice identically
         ident = {"n": n, "window": window, "cls": cls,
-                 "precompacted": bool(precompacted), "fp": fp, "fmt": fmt}
+                 "precompacted": bool(precompacted), "fp": fp, "fmt": fmt,
+                 "bw": bw}
         for b in range(n_batches):
             rec = jr.get({"batch": b})
             if rec is None:
@@ -793,7 +976,8 @@ def pack_file(path: str, out_path: str, cls: int = 64,
                 except OSError:
                     pass
                 return pack_file(path, out_path, cls, window,
-                                precompacted, limit_refs, _wide=True)
+                                precompacted, limit_refs, _wide=True,
+                                batch_windows=bw)
             if _wide:
                 ids.astype("<i4").tofile(out)
             else:
@@ -806,7 +990,7 @@ def pack_file(path: str, out_path: str, cls: int = 64,
             journal.record({"batch": b}, out_bytes=out.tell(),
                            comp=comp.snapshot(), n=n, window=window,
                            cls=cls, precompacted=bool(precompacted),
-                           fp=fp, fmt=fmt)
+                           fp=fp, fmt=fmt, bw=bw)
     os.replace(tmp, out_path)
     meta = {"n": n, "n_lines": comp.next_free, "fmt": fmt}
     with open(out_path + ".json", "w") as f:
@@ -830,12 +1014,15 @@ def _stage_fn(backend: str):
 
 
 @functools.lru_cache(maxsize=8)
-def _resident_fn(n_batches: int, window: int, pos_dtype_name: str,
-                 backend: str):
+def _resident_fn(window: int, pos_dtype_name: str, backend: str,
+                 segmented: bool):
     """One-dispatch replay over the device-resident packed trace: an outer
-    scan over batches, each batch the same inner scan as the streamed path."""
+    scan over batches, each batch the same kernel as the streamed path
+    (segmented whole-batch by default; per-window legacy scan for A/B).
+    Batch count and width come from the resident array's shape, so one
+    cached wrapper serves every ``--batch-windows`` setting (jit retraces
+    per shape)."""
     pdt = jnp.dtype(pos_dtype_name)
-    batch = WINDOWS_PER_BATCH * window
 
     def run(resident, last_pos, hist, n_valid, clock0):
         # clock0 shifts the logical-clock origin: reuse distances are
@@ -844,12 +1031,19 @@ def _resident_fn(n_batches: int, window: int, pos_dtype_name: str,
         # tunneled backend memoizes (executable, inputs) -> result; a
         # second bit-identical call would "run" in microseconds).  The
         # caller shifts n_valid by the same amount.
+        n_batches = resident.shape[0]
+        batch = resident.shape[1] * window
+
         def outer(carry, xs):
             last_pos, hist = carry
             b, ids = xs
-            last_pos, hist = _scan_batch(
-                last_pos, hist, clock0 + b.astype(pdt) * batch, ids,
-                n_valid, window, pdt)
+            base = clock0 + b.astype(pdt) * batch
+            if segmented:
+                last_pos, hist = _segmented_batch(
+                    last_pos, hist, base, ids, n_valid, pdt)
+            else:
+                last_pos, hist = _scan_batch(
+                    last_pos, hist, base, ids, n_valid, window, pdt)
             return (last_pos, hist), None
 
         (last_pos, hist), _ = jax.lax.scan(
@@ -866,7 +1060,9 @@ def replay_resident(packed_path: str, meta: dict,
                     limit_refs: int | None = None,
                     upload_budget_s: float | None = None,
                     clock0: int = 0,
-                    stats: dict | None = None) -> ReplayResult:
+                    stats: dict | None = None,
+                    batch_windows: int | None = None,
+                    segmented: bool | None = None) -> ReplayResult:
     """Replay from DEVICE memory: stage the packed trace into HBM once,
     then run the whole scan in one dispatch at device rate.
 
@@ -883,25 +1079,28 @@ def replay_resident(packed_path: str, meta: dict,
     contract as the bench's end-to-end metric).
     """
     resident, n_run, stats2 = stage_resident(
-        packed_path, meta, window, limit_refs, upload_budget_s)
+        packed_path, meta, window, limit_refs, upload_budget_s,
+        batch_windows=batch_windows)
     if stats is not None:
         stats.update(stats2)
     if n_run == 0:
         return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)
     return replay_staged(resident, meta["n_lines"], n_run, window,
-                         clock0=clock0, stats=stats)
+                         clock0=clock0, stats=stats, segmented=segmented)
 
 
 def stage_resident(packed_path: str, meta: dict,
                    window: int = TRACE_WINDOW,
                    limit_refs: int | None = None,
-                   upload_budget_s: float | None = None):
+                   upload_budget_s: float | None = None,
+                   batch_windows: int | None = None):
     """Upload a packed trace into HBM.  Returns ``(resident, n_run, stats)``
-    — the device array ([n_batches, WINDOWS_PER_BATCH, window, 3|4] u8 —
+    — the device array ([n_batches, batch_windows, window, 3|4] u8 —
     last dim per the ``u24``/``i32`` wire format), the staged ref count
     (may be a prefix under ``upload_budget_s``), and ``{upload_s,
     upload_bytes}``.  Staging once serves any number of
-    :func:`replay_staged` calls."""
+    :func:`replay_staged` calls (which read the batch width back off the
+    resident array's shape)."""
     import time
 
     if meta["fmt"] not in ("u24", "i32"):
@@ -910,13 +1109,13 @@ def stage_resident(packed_path: str, meta: dict,
     n = meta["n"] if limit_refs is None else min(meta["n"], limit_refs)
     if n == 0:
         return None, 0, {"upload_s": 0.0, "upload_bytes": 0}
-    batch = WINDOWS_PER_BATCH * window
+    bw = _resolve_bw(batch_windows)
+    batch = bw * window
     n_batches = -(-n // batch)
     stage = _stage_fn(jax.default_backend())
 
     t0 = time.perf_counter()
-    resident = jnp.zeros((n_batches, WINDOWS_PER_BATCH, window, bpr),
-                         jnp.uint8)
+    resident = jnp.zeros((n_batches, bw, window, bpr), jnp.uint8)
     staged = 0
     with open(packed_path, "rb") as f:
         for b in range(n_batches):
@@ -927,7 +1126,7 @@ def stage_resident(packed_path: str, meta: dict,
                 raw = np.concatenate([raw, np.zeros(pad, np.uint8)])
             resident = stage(
                 resident,
-                jnp.asarray(raw.reshape(1, WINDOWS_PER_BATCH, window, bpr)),
+                jnp.asarray(raw.reshape(1, bw, window, bpr)),
                 jnp.int32(b))
             staged = b + 1
             if upload_budget_s is not None and staged < n_batches \
@@ -950,7 +1149,8 @@ def stage_resident(packed_path: str, meta: dict,
 
 def replay_staged(resident, n_lines: int, n_run: int,
                   window: int = TRACE_WINDOW, clock0: int = 0,
-                  stats: dict | None = None) -> ReplayResult:
+                  stats: dict | None = None,
+                  segmented: bool | None = None) -> ReplayResult:
     """Replay an already-staged resident trace (see :func:`stage_resident`).
 
     ``clock0`` shifts the logical-clock origin — histogram-invariant, but
@@ -958,7 +1158,7 @@ def replay_staged(resident, n_lines: int, n_run: int,
     import time
 
     n_batches = resident.shape[0]
-    batch = WINDOWS_PER_BATCH * window
+    batch = resident.shape[1] * window
     pos_dtype = ("int32" if clock0 + n_batches * batch < 2**31 - 2
                  else "int64")
     if pos_dtype == "int64" and not jax.config.jax_enable_x64:
@@ -966,7 +1166,10 @@ def replay_staged(resident, n_lines: int, n_run: int,
             f"trace of {n_run} accesses needs int64 positions; enable "
             "jax_enable_x64")
     pdt = np.dtype(pos_dtype)
-    fn = _resident_fn(n_batches, window, pos_dtype, jax.default_backend())
+    if segmented is None:
+        segmented = _segmented_default()
+    fn = _resident_fn(window, pos_dtype, jax.default_backend(),
+                      bool(segmented))
     last_pos = jnp.full((n_lines,), -1, pdt)
     hist = jnp.zeros((NBINS,), pdt)
     t0 = time.perf_counter()
@@ -1114,8 +1317,6 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
     run.  A checkpoint for a different (file, shape, mesh) identity is
     ignored with a notice, never spliced.
     """
-    import os
-
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1123,6 +1324,7 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
     from pluss.resilience.journal import Journal
     from pluss.utils import compat
 
+    batch_windows = _resolve_bw(batch_windows)
     mesh = mesh or default_mesh()
     D = mesh.devices.size
     if jax.process_count() > 1 and not precompacted:
@@ -1371,8 +1573,6 @@ def _u64_count(path: str) -> int:
     every later analysis, so it is a classified
     :class:`~pluss.resilience.errors.DataLoss` naming the exact offset.
     """
-    import os
-
     from pluss.resilience.errors import DataLoss
 
     size = os.path.getsize(path)

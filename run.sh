@@ -21,6 +21,12 @@ python -m pluss.cli lint --all 1>&2
 # still pure host analysis, ~20 s for the registry at default sizes.
 python -m pluss.cli analyze --all 1>&2
 
+# trace replay smoke (tier-1): pack_file → replay_file → fault-interrupted
+# checkpoint --resume equivalence + legacy-kernel A/B on a ~1e6-ref
+# synthetic trace, pinned to the CPU backend (~10 s).  The replay path is
+# exercised on every PR, not just in the budget-gated bench.
+JAX_PLATFORMS=cpu python -m pluss.trace_smoke 1>&2
+
 # opt-in chaos smoke (PLUSS_CHAOS=1): a short seeded fault-plan soak on the
 # CPU backend — every injected fault (OOM / compile / share-cap / corrupt
 # cache) must either recover to a bit-exact result via the degradation

@@ -126,6 +126,25 @@ def test_trace_mode(tmp_path, capsys):
     assert out.read_text().startswith("miss ratio")
 
 
+def test_trace_mode_batch_windows_flag(tmp_path, capsys):
+    # --batch-windows re-cuts the device batches; the histogram block must
+    # be byte-identical to the default batching (partition invariance)
+    import numpy as np
+
+    from pluss import cli
+
+    path = tmp_path / "t.bin"
+    rng = np.random.default_rng(7)
+    (rng.integers(0, 256, 5000) * 64).astype("<u8").tofile(path)
+    outs = []
+    for extra in ([], ["--batch-windows", "2"]):
+        cli.main(["trace", "--file", str(path), "--cpu", "--window", "512",
+                  "--out", str(tmp_path / "m.csv")] + extra)
+        outs.append([l for l in capsys.readouterr().out.splitlines()
+                     if not l.startswith("TPU TRACE:")])
+    assert outs[0] == outs[1]
+
+
 def test_trace_mode_shard_backend(tmp_path, capsys):
     # --backends shard routes trace mode through the device-sharded replay;
     # histogram lines must equal the streamed path's (table-slot diagnostic

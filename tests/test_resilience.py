@@ -280,17 +280,21 @@ def _mk_trace(tmp_path, n=5 * 8 * 512 + 77, seed=0):
 
 
 def test_replay_checkpoint_resume_bit_exact(tmp_path):
+    # batch_windows pinned to 8: the fault-hit arithmetic below assumes
+    # 6 batches of 8*512 refs (the pre-round-6 default batching)
     tf, _ = _mk_trace(tmp_path)
     W = 512
     clean = trace.replay_file(tf, window=W)
     ck = str(tmp_path / "t.ckpt.npz")
     faults.install(FaultPlan.parse("trace_loss@4"))
     with pytest.raises(DataLoss):
-        trace.replay_file(tf, window=W, checkpoint_path=ck,
+        trace.replay_file(tf, window=W, batch_windows=8,
+                          checkpoint_path=ck,
                           checkpoint_every=1, resume=True)
     faults.install(None)
     assert os.path.exists(ck)
-    res = trace.replay_file(tf, window=W, checkpoint_path=ck,
+    res = trace.replay_file(tf, window=W, batch_windows=8,
+                            checkpoint_path=ck,
                             checkpoint_every=1, resume=True)
     assert res.hist.tolist() == clean.hist.tolist()
     assert res.total_count == clean.total_count
@@ -314,12 +318,14 @@ def test_replay_checkpoint_shape_mismatch_starts_fresh(tmp_path, capsys):
     ck = str(tmp_path / "t.ckpt.npz")
     faults.install(FaultPlan.parse("trace_loss@2"))
     with pytest.raises(DataLoss):
-        trace.replay_file(tf, window=512, checkpoint_path=ck,
+        trace.replay_file(tf, window=512, batch_windows=8,
+                          checkpoint_path=ck,
                           checkpoint_every=1, resume=True)
     faults.install(None)
     # different window shape: the checkpoint must be ignored, not mixed in
     clean = trace.replay_file(tf, window=256)
-    res = trace.replay_file(tf, window=256, checkpoint_path=ck,
+    res = trace.replay_file(tf, window=256, batch_windows=8,
+                            checkpoint_path=ck,
                             checkpoint_every=1, resume=True)
     assert res.hist.tolist() == clean.hist.tolist()
     assert "different run" in capsys.readouterr().err
@@ -348,18 +354,21 @@ def test_pack_file_resume_walks_back_past_missing_bytes(tmp_path):
     # batch whose bytes exist, never truncate forward (zero-extension)
     tf, _ = _mk_trace(tmp_path)
     W = 512
+    # batch_windows pinned to 8: the journal-batch arithmetic below
+    # assumes 6 batches of 8*512 refs (the pre-round-6 default batching)
     trace.pack_file(tf, str(tmp_path / "clean.pack"), window=W)
     crash = str(tmp_path / "y.pack")
     faults.install(FaultPlan.parse("trace_loss@4"))
     with pytest.raises(DataLoss):
-        trace.pack_file(tf, crash, window=W)
+        trace.pack_file(tf, crash, window=W, batch_windows=8)
     faults.install(None)
     j = Journal(crash + ".journal")
     b1 = j.get({"batch": 1})["out_bytes"]
     b2 = j.get({"batch": 2})["out_bytes"]
     with open(crash + ".tmp", "r+b") as f:
         f.truncate((b1 + b2) // 2)   # batch 2's tail bytes "lost"
-    meta = trace.pack_file(tf, crash, window=W, resume=True)
+    meta = trace.pack_file(tf, crash, window=W, resume=True,
+                           batch_windows=8)
     assert (tmp_path / "clean.pack").read_bytes() == \
         open(crash, "rb").read()
     assert meta["n_lines"] > 0
@@ -374,16 +383,18 @@ def test_pack_file_fresh_start_clears_stale_journal(tmp_path):
     trace.pack_file(tf, str(tmp_path / "clean.pack"), window=W)
     clean_bytes = (tmp_path / "clean.pack").read_bytes()
     crash = str(tmp_path / "x.pack")
+    # batch_windows pinned to 8: the fault hits below assume 6 batches
     faults.install(FaultPlan.parse("trace_loss@5"))   # run A: crash late
     with pytest.raises(DataLoss):
-        trace.pack_file(tf, crash, window=W)
+        trace.pack_file(tf, crash, window=W, batch_windows=8)
     faults.install(None)
     os.unlink(crash + ".tmp")      # A's partial output is lost entirely
     faults.install(FaultPlan.parse("trace_loss@2"))   # run B: fresh, early
     with pytest.raises(DataLoss):
-        trace.pack_file(tf, crash, window=W)
+        trace.pack_file(tf, crash, window=W, batch_windows=8)
     faults.install(None)
-    meta = trace.pack_file(tf, crash, window=W, resume=True)
+    meta = trace.pack_file(tf, crash, window=W, resume=True,
+                           batch_windows=8)
     assert open(crash, "rb").read() == clean_bytes
     assert meta["n_lines"] > 0
 
@@ -393,6 +404,23 @@ def test_replay_resilient_classifies_data_loss(tmp_path):
     faults.install(FaultPlan.parse("trace_loss"))
     with pytest.raises(DataLoss):
         replay_file_resilient(tf, window=512, retry=Retry(backoff_s=0))
+
+
+def test_replay_resilient_passes_batching_knobs_through(tmp_path):
+    """The ladder wrapper forwards the round-6 feed knobs (batch_windows,
+    queue_depth, segmented) untouched, and deadline truncation under the
+    wrapper still cuts exactly on the configured batch boundary."""
+    tf, _ = _mk_trace(tmp_path)
+    ref = trace.replay_file(tf, window=512)
+    res = replay_file_resilient(tf, window=512, batch_windows=3,
+                                queue_depth=1, segmented=False,
+                                retry=Retry(backoff_s=0))
+    assert res.degradations == ()
+    np.testing.assert_array_equal(res.hist, ref.hist)
+    cut = replay_file_resilient(tf, window=512, batch_windows=2,
+                                deadline_s=0.0, retry=Retry(backoff_s=0))
+    assert 0 < cut.total_count <= ref.total_count
+    assert cut.total_count % (2 * 512) == 0
 
 
 # ---------------------------------------------------------------------------

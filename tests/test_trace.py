@@ -357,6 +357,181 @@ def test_pack_file_u24_boundary_stays_narrow(tmp_path):
     assert meta["fmt"] == "u24" and meta["n_lines"] < 1 << 24
 
 
+def test_segmented_vs_legacy_scan_bit_identical():
+    """The whole-batch segmented kernel (round-6 default) must reproduce
+    the legacy per-window scan bit-for-bit — reuse gaps are partition-
+    invariant and both histogram paths are integer-exact."""
+    rng = np.random.default_rng(41)
+    addrs = rng.integers(0, 1 << 13, 9000) * 64
+    seg = trace.replay(addrs, window=1 << 9, segmented=True)
+    leg = trace.replay(addrs, window=1 << 9, segmented=False)
+    np.testing.assert_array_equal(seg.hist, leg.hist)
+    assert seg.histogram() == oracle_replay(addrs)
+
+
+def test_batch_windows_histogram_invariance(tmp_path):
+    """The histogram must not depend on how the stream is cut into
+    batches: batch_windows 1, 3 and the default all agree (and with the
+    legacy scan at a non-default width)."""
+    rng = np.random.default_rng(43)
+    addrs = rng.integers(0, 1 << 11, 7000, dtype=np.int64) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    # segmented pinned on: the CPU backend's default is the legacy scan
+    ref = trace.replay_file(str(p), window=1 << 9, segmented=True)
+    for bw in (1, 3):
+        res = trace.replay_file(str(p), window=1 << 9, batch_windows=bw,
+                                segmented=True)
+        np.testing.assert_array_equal(res.hist, ref.hist)
+    leg = trace.replay_file(str(p), window=1 << 9, batch_windows=3,
+                            segmented=False)
+    np.testing.assert_array_equal(leg.hist, ref.hist)
+
+
+@pytest.mark.parametrize("bw,qd", [(2, 1), (5, 4)])
+def test_deadline_truncates_on_custom_batch_boundary(tmp_path, bw, qd):
+    """deadline_s truncation must land exactly on a batch boundary under
+    the overlapped (double-buffered) staging, for any --batch-windows and
+    reader queue depth (ISSUE 4 satellite regression)."""
+    rng = np.random.default_rng(47)
+    window = 1 << 8
+    n = bw * window * 9 + 17
+    addrs = rng.integers(0, 1 << 11, n, dtype=np.int64) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    res = trace.replay_file(str(p), window=window, batch_windows=bw,
+                            queue_depth=qd, deadline_s=0.0)
+    assert 0 < res.total_count < n
+    assert res.total_count % (bw * window) == 0   # exact batch boundary
+    ref = trace.replay(addrs[:res.total_count], window=window)
+    np.testing.assert_array_equal(res.hist, ref.hist)
+
+
+def test_threaded_queue_depth_env(tmp_path, monkeypatch):
+    """PLUSS_TRACE_QUEUE_DEPTH steers the reader queue bound (kwarg wins
+    over env; both replay correctly)."""
+    rng = np.random.default_rng(53)
+    addrs = rng.integers(0, 1 << 10, 4000, dtype=np.int64) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    monkeypatch.setenv("PLUSS_TRACE_QUEUE_DEPTH", "1")
+    a = trace.replay_file(str(p), window=1 << 9, batch_windows=2)
+    b = trace.replay_file(str(p), window=1 << 9, batch_windows=2,
+                          queue_depth=6)
+    assert a.histogram() == b.histogram() == oracle_replay(addrs)
+
+
+def test_ckpt_saves_live_prefix_only(tmp_path):
+    """The replay checkpoint stores only the live last_pos prefix (plus
+    the capacity), not the whole padded table — and a resume from it is
+    bit-identical (ISSUE 4 satellite)."""
+    from pluss.resilience import faults
+    from pluss.resilience.errors import DataLoss
+
+    rng = np.random.default_rng(59)
+    window = 1 << 8
+    bw = 2
+    n = bw * window * 8
+    addrs = rng.integers(0, 1 << 9, n, dtype=np.int64) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    ckpt = str(tmp_path / "t.ckpt.npz")
+    ref = trace.replay_file(str(p), window=window, batch_windows=bw)
+
+    faults.install(faults.FaultPlan.parse("trace_loss@5"))
+    try:
+        with pytest.raises(DataLoss):
+            trace.replay_file(str(p), window=window, batch_windows=bw,
+                              initial_capacity=1 << 12,
+                              checkpoint_path=ckpt, checkpoint_every=1)
+    finally:
+        faults.install(None)
+    with np.load(ckpt) as z:
+        cap = int(z["capacity"])
+        live = z["last_pos"].shape[0]
+        assert cap == 1 << 12
+        assert live < cap                  # only the prefix is on disk
+        assert live >= (1 << 9)            # ...but all live slots are
+    res = trace.replay_file(str(p), window=window, batch_windows=bw,
+                            initial_capacity=1 << 12,
+                            checkpoint_path=ckpt, resume=True)
+    np.testing.assert_array_equal(res.hist, ref.hist)
+
+
+def test_ckpt_rejects_different_batch_windows(tmp_path):
+    """batch_windows is part of the checkpoint identity: a checkpoint cut
+    at one batch width must never splice into a run at another."""
+    from pluss.resilience import faults
+    from pluss.resilience.errors import DataLoss
+
+    rng = np.random.default_rng(61)
+    window = 1 << 8
+    n = 4 * window * 8
+    addrs = rng.integers(0, 1 << 9, n, dtype=np.int64) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    ckpt = str(tmp_path / "t.ckpt.npz")
+    faults.install(faults.FaultPlan.parse("trace_loss@5"))
+    try:
+        with pytest.raises(DataLoss):
+            trace.replay_file(str(p), window=window, batch_windows=2,
+                              checkpoint_path=ckpt, checkpoint_every=1)
+    finally:
+        faults.install(None)
+    # resume at a DIFFERENT batch width: starts fresh, still exact
+    res = trace.replay_file(str(p), window=window, batch_windows=4,
+                            checkpoint_path=ckpt, resume=True)
+    ref = trace.replay(addrs, window=window)
+    np.testing.assert_array_equal(res.hist, ref.hist)
+
+
+def test_batching_knobs_validated(tmp_path):
+    """Invalid batch_windows / queue_depth must fail loudly: a negative
+    batch count used to return an all-zero histogram claiming full
+    coverage, and queue depth 0 makes python's Queue UNBOUNDED
+    (code-review findings on the round-6 knobs)."""
+    addrs = np.arange(100, dtype=np.int64) * 64
+    p = tmp_path / "t.bin"
+    addrs.astype("<u8").tofile(p)
+    with pytest.raises(ValueError, match="batch_windows"):
+        trace.replay(addrs, window=64, batch_windows=-4)
+    with pytest.raises(ValueError, match="batch_windows"):
+        trace.replay_file(str(p), window=64, batch_windows=0)
+    with pytest.raises(ValueError, match="batch_windows"):
+        trace.pack_file(str(p), str(tmp_path / "t.pack"), window=64,
+                        batch_windows=-1)
+    with pytest.raises(ValueError, match="queue_depth"):
+        trace.replay_file(str(p), window=64, queue_depth=0)
+
+
+def test_pack24_pack_unpack_roundtrip():
+    """The vectorized _pack24 matches the 3-masked-stores reference
+    byte-for-byte, including the 2^24-1 ceiling."""
+    rng = np.random.default_rng(67)
+    ids = np.concatenate([
+        rng.integers(0, 1 << 24, 1000, dtype=np.int32),
+        np.array([0, 1, 0xFF, 0x100, 0xFFFF, 0x10000, (1 << 24) - 1],
+                 np.int32)])
+    ref = np.empty((len(ids), 3), np.uint8)
+    ref[:, 0] = ids & 0xFF
+    ref[:, 1] = (ids >> 8) & 0xFF
+    ref[:, 2] = (ids >> 16) & 0xFF
+    out = trace._pack24(ids)
+    assert out.flags["C_CONTIGUOUS"]
+    np.testing.assert_array_equal(out, ref)
+    # non-contiguous input (a strided slice) must pack identically
+    np.testing.assert_array_equal(trace._pack24(ids[::2]), ref[::2])
+
+
+def test_trace_smoke_wrapper():
+    """The run.sh tier-1 smoke, importable: pack → replay_file →
+    interrupted --resume → legacy A/B on a small synthetic trace."""
+    from pluss import trace_smoke
+
+    assert trace_smoke.main(n_refs=1 << 18, window=1 << 12,
+                            batch_windows=4) == 0
+
+
 def test_shard_replay_file_resume_checkpoint(tmp_path):
     """Interrupted sharded replay resumes from the journal + npz
     checkpoint bit-identically (PR-2 follow-up)."""
