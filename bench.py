@@ -378,29 +378,58 @@ def native_trace_rate(path: str) -> float | None:
     return ent["refs"] / ent["s"] if ent else None
 
 
-def bench_trace_resident(n_refs: int) -> None:
-    """Staged-resident replay (VERDICT r3 task 3b): upload the packed trace
-    to HBM once, replay from device memory — upload and replay reported
-    separately, so the metric is independent of tunnel h2d weather.  The
-    packed-id file is produced once by trace.pack_file and cached."""
+def cached_pack(path: str, n_refs: int) -> tuple[dict | None, bool, str]:
+    """(pack sidecar meta, was_cached, packed path) of the staged
+    (packed) trace, persisted across runs and keyed by size +
+    source-trace content + wire-format version — like the
+    native-baseline cache.  The old
+    existence-only check would happily replay a stale pack after the
+    source trace regenerated or the wire format changed; now a key
+    mismatch forces a repack (with a logged reason), and the metric line
+    carries ``staging_cached`` so a round that paid the ~minutes repack
+    is distinguishable from one that reused the staged bytes."""
     import json as _json
 
     from pluss import trace
 
-    path = ensure_trace(n_refs)
     packed = f".bench/trace_{n_refs}.pack"
     sidecar = packed + ".json"
     if os.path.exists(packed) and os.path.exists(sidecar):
-        with open(sidecar) as f:
-            meta = _json.load(f)
-    else:
-        if not budget_ok("trace pack_file (one-time)", 420):
-            return
-        log(f"bench: packing trace ids (one-time) at {packed}")
-        t0 = time.perf_counter()
-        meta = trace.pack_file(path, packed)
-        log(f"bench: packed in {time.perf_counter() - t0:.1f}s "
-            f"({meta['n_lines']} line slots)")
+        try:
+            with open(sidecar) as f:
+                meta = _json.load(f)
+        except ValueError:
+            meta = {}
+        if meta.get("n") == n_refs \
+                and meta.get("src_fp") == trace._trace_fingerprint(path) \
+                and meta.get("wire") == trace.WIRE_VERSION:
+            log(f"bench: staged trace pack {packed}: cached "
+                f"({meta['n_lines']} line slots, fmt {meta['fmt']})")
+            return meta, True, packed
+        log("bench: staged trace pack is stale (source trace or wire "
+            "format changed); repacking")
+    if not budget_ok("trace pack_file (one-time)", 420):
+        return None, False, packed
+    log(f"bench: packing trace ids (one-time) at {packed}")
+    t0 = time.perf_counter()
+    meta = trace.pack_file(path, packed)
+    log(f"bench: packed in {time.perf_counter() - t0:.1f}s "
+        f"({meta['n_lines']} line slots)")
+    return meta, False, packed
+
+
+def bench_trace_resident(n_refs: int) -> None:
+    """Staged-resident replay (VERDICT r3 task 3b): upload the packed trace
+    to HBM once, replay from device memory — upload and replay reported
+    separately, so the metric is independent of tunnel h2d weather.  The
+    packed-id file is produced once by trace.pack_file and reused across
+    rounds via :func:`cached_pack`."""
+    from pluss import trace
+
+    path = ensure_trace(n_refs)
+    meta, staging_cached, packed = cached_pack(path, n_refs)
+    if meta is None:
+        return
     # staging budget: leave room for the e2e metric after us
     upload_budget = max(30.0, min(remaining_s() * 0.5, 300.0))
     resident, n_run, stats = trace.stage_resident(
@@ -427,6 +456,7 @@ def bench_trace_resident(n_refs: int) -> None:
          path="trace_resident",
          refs_replayed=n_run, refs_requested=n_refs,
          shrunk=bool(n_run != n_refs),
+         staging_cached=staging_cached,
          upload_s=round(stats["upload_s"], 1),
          upload_mb_s=round(mb / stats["upload_s"], 2))
 
@@ -474,14 +504,33 @@ def bench_trace(n_refs: int) -> None:
     # the deadline (1.3x the projected budget) is the backstop for the
     # feed SLOWING mid-run — a pre-run projection cannot see that
     # (observed: projected at ~23 MB/s, finished at ~5 MB/s, 3x over)
+    from pluss import obs
     from pluss.resilience import replay_file_resilient
 
+    c0 = obs.counters()
     rep = replay_file_resilient(
         path, limit_refs=n_run,
         deadline_s=min(budget_s * 1.3, max(remaining_s() - 30, 1)))
     best_s = time.perf_counter() - t0
     n_run = rep.total_count
     log(f"bench: {n_run} refs over {rep.n_lines} line slots")
+    # the telemetry breakdown of the measured region, straight onto the
+    # metric line: feed_stall_frac is the feed-bound diagnosis (r05's
+    # 0.34x was BELIEVED h2d-bound; now the record says where the seconds
+    # went), resolvable offline too via `pluss stats` on the stream
+    c1 = obs.counters()
+    obs_extra: dict = {}
+    if obs.enabled():
+        def delta(k):
+            return c1.get(k, 0.0) - c0.get(k, 0.0)
+
+        stall, h2d_s = delta("trace.prefetch_stall_s"), delta("trace.h2d_s")
+        obs_extra = {
+            "feed_stall_frac": round_keep(stall / best_s, 4),
+            "device_frac": round_keep(delta("trace.device_s") / best_s, 4),
+            "h2d_mb_s": round_keep(delta("trace.h2d_bytes") / 1e6 / h2d_s, 2)
+            if h2d_s > 0 else None,
+        }
     # native replay is linear in refs, so one measured (refs, seconds) pair
     # scales to whatever prefix the feed budget allowed this round
     rate = native_trace_rate(path)
@@ -492,7 +541,7 @@ def bench_trace(n_refs: int) -> None:
     emit(f"trace{n_refs}_replay_refs_per_sec", n_run, best_s, base_s,
          path="trace_stream", degradations=tuple(rep.degradations),
          refs_replayed=n_run, refs_requested=n_refs,
-         shrunk=bool(n_run != n_refs))
+         shrunk=bool(n_run != n_refs), **obs_extra)
 
 
 def main() -> int:
@@ -505,6 +554,14 @@ def main() -> int:
     from pluss.utils.platform import enable_x64
 
     enable_x64()
+    # telemetry on by default for bench runs: the event stream is part of
+    # the round record (feed_stall_frac etc. on the metric lines come from
+    # counter deltas; `pluss stats .bench/telemetry.jsonl` re-derives the
+    # full breakdown offline).  PLUSS_TELEMETRY overrides the sink path.
+    from pluss import obs
+
+    if not obs.enabled():
+        obs.configure(".bench/telemetry.jsonl")
     os.makedirs(".bench/jit_cache", exist_ok=True)
     jax.config.update("jax_compilation_cache_dir",
                       os.path.abspath(".bench/jit_cache"))

@@ -25,6 +25,11 @@ enumeration — bounds proofs, race detection, share-span validation and
 contract checks as stable PLxxx diagnostics (``--json`` for tooling).
 ``--verify`` opts the engine modes into the same analysis as a pre-pass:
 ERROR-level findings abort before any compilation.
+``stats`` aggregates a telemetry event stream (``--telemetry`` /
+``PLUSS_TELEMETRY`` on any engine mode records one): span tree,
+counter/gauge rollups, and the trace-replay time breakdown;
+``--check`` validates the stream against the schema instead
+(:mod:`pluss.obs`).
 
 The timed region matches the reference: ``sampler() + pluss_cri_distribute``
 (…omp.cpp:337-339).  Compilation is excluded by a warmup call — the analogue of
@@ -190,7 +195,19 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="pluss", description=__doc__)
     p.add_argument("mode",
                    choices=("acc", "speed", "mrc", "trace", "sweep",
-                            "sample", "lint", "analyze"))
+                            "sample", "lint", "analyze", "stats"))
+    p.add_argument("target", nargs="?", default=None,
+                   help="stats mode: telemetry event stream (events.jsonl) "
+                        "to aggregate")
+    p.add_argument("--check", action="store_true",
+                   help="stats mode: validate the event stream against "
+                        "the telemetry schema instead of rendering it "
+                        "(exit 1 on any violation)")
+    p.add_argument("--telemetry", metavar="PATH", default=None,
+                   help="write a structured telemetry event stream "
+                        "(spans/counters/gauges as JSONL) to PATH; "
+                        "equivalently set PLUSS_TELEMETRY.  Aggregate "
+                        "with `pluss stats PATH`")
     p.add_argument("--all", action="store_true",
                    help="lint/analyze mode: analyze every registered model "
                         "family (at each builder's default size) instead "
@@ -256,6 +273,30 @@ def main(argv: list[str] | None = None) -> int:
                    help="write a jax profiler trace of the timed region to "
                         "DIR (view with tensorboard or xprof)")
     args = p.parse_args(argv)
+
+    if args.target is not None and args.mode != "stats":
+        # the optional positional exists only for `stats <events.jsonl>`;
+        # anywhere else a stray argument must stay the usage error it
+        # always was (`pluss lint gemm` would otherwise silently lint the
+        # DEFAULT model and report it clean)
+        p.error(f"unexpected argument {args.target!r} for mode "
+                f"{args.mode!r} (positional input is stats-mode only; "
+                "use --model/--file)")
+
+    if args.mode == "stats":
+        # pure host aggregation of a recorded stream: no accelerator, no
+        # platform setup, and no telemetry session of its own
+        from pluss.obs import stats as stats_mod
+
+        if not args.target:
+            p.error("stats mode requires an events.jsonl path")
+        return stats_mod.main(args.target, sys.stdout, sys.stderr,
+                              check=args.check)
+
+    from pluss import obs
+
+    if args.telemetry:
+        obs.configure(args.telemetry)
 
     if args.mode in ("lint", "analyze"):
         # pure host analysis: no accelerator probe, no platform setup —
@@ -467,6 +508,9 @@ def main(argv: list[str] | None = None) -> int:
         mrc.write_mrc(args.out, curve)
         out.write(f"{rep.total_count} refs over {rep.n_lines} lines; "
                   f"wrote MRC to {args.out}\n")
+    # counters land in the stream even when the process is long-lived
+    # (the session itself closes at exit, or at the next configure)
+    obs.flush_metrics()
     return 0
 
 

@@ -240,7 +240,10 @@ def distribute(noshare: list[Histogram], share: list[Histogram],
                thread_cnt: int) -> Histogram:
     """``pluss_cri_distribute`` (utils.rs:346-349): fresh result per call —
     the per-run reset the reference's Rust build lacks (SURVEY.md Q1)."""
-    rihist: Histogram = {}
-    noshare_distribute(noshare, rihist, thread_cnt)
-    racetrack(share, rihist, thread_cnt)
-    return rihist
+    from pluss import obs
+
+    with obs.span("cri.distribute", threads=thread_cnt):
+        rihist: Histogram = {}
+        noshare_distribute(noshare, rihist, thread_cnt)
+        racetrack(share, rihist, thread_cnt)
+        return rihist

@@ -39,7 +39,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pluss import obs
 from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
+from pluss.obs import xprof
 from pluss.ops.reuse import (
     bin_histogram,
     carried_events,
@@ -124,7 +126,10 @@ def _plan_cache_key(spec, cfg, ni: int, W: int, NW: int) -> str:
 
 def _plan_cache_get(key: str):
     path = _plan_cache_path(key)
-    if path is None or not os.path.exists(path):
+    if path is None:
+        return None
+    if not os.path.exists(path):
+        obs.counter_add("engine.plan_cache.miss")
         return None
     import pickle
 
@@ -133,13 +138,16 @@ def _plan_cache_get(key: str):
     faults.corrupt("plan_cache.get", path)   # chaos: corrupt_cache site
     try:
         with open(path, "rb") as f:
-            return pickle.load(f)
+            value = pickle.load(f)
+        obs.counter_add("engine.plan_cache.hit")
+        return value
     except Exception as e:
         # QUARANTINE, don't silently rebuild every run: rename the bad
         # bytes aside (diagnosable later) so the rebuilt artifact can land
         # in the now-free slot, and say what happened once
         from pluss.resilience.errors import quarantine_artifact
 
+        obs.counter_add("engine.plan_cache.corrupt")
         quarantine_artifact(path, "engine plan-cache", e)
         return None
 
@@ -1420,8 +1428,10 @@ def _plan_cached(spec: LoopNestSpec, cfg: SamplerConfig, assignment,
                  sort_concurrency) -> StreamPlan:
     """Shared plan memo for the sliced runner (compiled() memoizes its own
     plan inside its cache entry)."""
-    return plan(spec, cfg, assignment, start_point, window_accesses,
-                sort_concurrency=sort_concurrency)
+    with obs.span("engine.plan", model=spec.name,
+                  threads=cfg.thread_num, chunk=cfg.chunk_size):
+        return plan(spec, cfg, assignment, start_point, window_accesses,
+                    sort_concurrency=sort_concurrency)
 
 
 def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
@@ -1471,20 +1481,30 @@ def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     last_pos = jnp.full((T, n_lines), -1, pdt)
     hist = jnp.zeros((T, NBINS), pdt)
     parts: list[list[tuple[int, object]]] = [[] for _ in pl.nests]
-    for ni, np_ in enumerate(pl.nests):
-        for si, (is_ultra, w_list, brefs) in enumerate(_segments_of(np_)):
-            epw = _segment_entries_per_window(np_, cfg, n_lines, is_ultra,
-                                              brefs)
-            wpd = max(1, min(len(w_list), budget // max(1, epw * conc)))
-            for lo in range(0, len(w_list), wpd):
-                sub = w_list[lo:lo + wpd]
-                fn = _slice_fn(pl, share_cap, ni, si, len(sub),
-                               thread_batch)
-                last_pos, hist, flat = fn(
-                    tids, last_pos, hist, jnp.asarray(sub, jnp.int32))
-                parts[ni].append((len(sub), flat))
-
-    hist_np = np.asarray(hist)
+    n_dispatches = 0
+    with obs.span("engine.dispatch", model=spec.name, backend="sliced",
+                  thread_batch=thread_batch or T) as sp, xprof.session():
+        for ni, np_ in enumerate(pl.nests):
+            for si, (is_ultra, w_list, brefs) in enumerate(
+                    _segments_of(np_)):
+                epw = _segment_entries_per_window(np_, cfg, n_lines,
+                                                  is_ultra, brefs)
+                wpd = max(1, min(len(w_list), budget // max(1, epw * conc)))
+                for lo in range(0, len(w_list), wpd):
+                    sub = w_list[lo:lo + wpd]
+                    fn = _slice_fn(pl, share_cap, ni, si, len(sub),
+                                   thread_batch)
+                    with xprof.annotate(
+                            f"pluss.engine.{spec.name}.n{ni}s{si}"):
+                        last_pos, hist, flat = fn(
+                            tids, last_pos, hist,
+                            jnp.asarray(sub, jnp.int32))
+                    parts[ni].append((len(sub), flat))
+                    n_dispatches += 1
+        hist_np = np.asarray(hist)   # the fetch forces every dispatch
+        sp.set(dispatches=n_dispatches)
+    obs.counter_add("engine.sliced_dispatches", n_dispatches)
+    obs.counter_add("engine.refs_processed", pl.total_count)
     share_ys = []
     for ni, np_ in enumerate(pl.nests):
         triples = 2 if np_.overlays else 1
@@ -1839,13 +1859,20 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             print(f"engine: auto-sliced dispatch "
                   f"(thread_batch={tb or cfg.thread_num}): {reason}",
                   file=sys.stderr)
+            obs.counter_add("engine.auto_dispatch_reroutes")
+            obs.event("engine.auto_dispatch", model=spec.name,
+                      thread_batch=tb or cfg.thread_num, reason=reason)
             return run_sliced(spec, cfg, share_cap, assignment, start_point,
                               window_accesses, tb, _fault_checked=True)
     pl, f = compiled(spec, cfg, share_cap, assignment, start_point,
                      window_accesses, backend,
                      _normalize_thread_batch(thread_batch, cfg))
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
-    hist, share_ys = _unpack(np.asarray(f(tids)), pl, share_cap)
+    with obs.span("engine.dispatch", model=spec.name, backend=backend), \
+            xprof.session(), xprof.annotate(f"pluss.engine.{spec.name}"):
+        packed = np.asarray(f(tids))
+    obs.counter_add("engine.refs_processed", pl.total_count)
+    hist, share_ys = _unpack(packed, pl, share_cap)
     try:
         return _finalize(pl, hist, share_ys, share_cap, cfg)
     except ShareCapExceeded as e:
@@ -1865,6 +1892,9 @@ def _auto_share_cap(e: ShareCapExceeded, share_cap: int) -> int:
     print(f"engine: share cap {share_cap} overflowed ({e.needed} uniques "
           f"in one window); re-running with share_cap={new_cap}",
           file=sys.stderr)
+    obs.counter_add("engine.share_cap_retries")
+    obs.event("engine.share_cap_overflow", needed=e.needed,
+              old_cap=share_cap, new_cap=new_cap)
     return new_cap
 
 
@@ -1873,6 +1903,12 @@ def _finalize(pl: StreamPlan, hist: np.ndarray, share_ys,
     """Shared tail of :func:`run` / :func:`run_sliced`: merge the per-window
     share outputs, add the host-side static share constants, settle overlay
     subtractions, and box the result."""
+    with obs.span("engine.finalize", model=pl.spec.name):
+        return _finalize_impl(pl, hist, share_ys, share_cap, cfg)
+
+
+def _finalize_impl(pl: StreamPlan, hist: np.ndarray, share_ys,
+                   share_cap: int, cfg: SamplerConfig) -> SamplerResult:
     from pluss.resilience import faults
 
     faults.check("engine.finalize")   # chaos injection site (share_cap)

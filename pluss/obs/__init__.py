@@ -1,0 +1,30 @@
+"""pluss.obs — structured telemetry for the whole pipeline.
+
+One substrate (counters / gauges / spans / events → an append-only JSONL
+sink, :mod:`pluss.obs.telemetry`), optional xprof trace annotation
+(:mod:`pluss.obs.xprof`, ``PLUSS_XPROF=dir``), and the ``pluss stats``
+aggregator (:mod:`pluss.obs.stats`).  Disabled (the default) every hook
+is a near-free no-op and the instrumented pipelines are bit-identical —
+telemetry is observably passive, enforced by tests/test_obs.py.
+
+Enable with ``PLUSS_TELEMETRY=<events.jsonl>`` or ``--telemetry`` on the
+CLI; ``PLUSS_PROM=<file>`` additionally exports a Prometheus-style
+textfile at shutdown.
+"""
+
+from pluss.obs.telemetry import (  # noqa: F401
+    NOOP_SPAN,
+    SCHEMA_VERSION,
+    Telemetry,
+    active,
+    configure,
+    counter_add,
+    counters,
+    enabled,
+    event,
+    flush_metrics,
+    gauge_set,
+    gauges,
+    shutdown,
+    span,
+)

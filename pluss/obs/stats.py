@@ -1,0 +1,303 @@
+"""``pluss stats``: aggregate one telemetry JSONL stream into a report.
+
+Renders the span tree (per name-path: count, total wall incl. children,
+self time excl. children), event counts, counter/gauge rollups
+(cumulative counters: the LAST record per name wins), and — when the
+trace-replay counters are present — the replay time breakdown the feed-
+bound diagnosis needs: reader prefetch-stall seconds, h2d staging time
+and MB/s, per-batch device time, checkpoint cost, and what fraction of
+the replay's wall clock those buckets account for.
+
+``--check`` validates the stream against the schema instead (exit 1 on
+any violation).  A torn FINAL line is tolerated with a notice — that is
+the expected crash artifact of the sink's append discipline; torn or
+alien lines anywhere else are violations.
+"""
+
+from __future__ import annotations
+
+import json
+
+from pluss.obs.telemetry import EVENT_KINDS, SCHEMA_VERSION
+
+
+def load(path: str) -> tuple[list[dict], list[str], list[str]]:
+    """(records, problems, notes) of one stream.  ``problems`` are schema
+    violations (--check failures); ``notes`` are tolerated oddities."""
+    problems: list[str] = []
+    notes: list[str] = []
+    records: list[dict] = []
+    with open(path, "rb") as f:
+        raw = f.read()
+    lines = raw.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    for i, line in enumerate(lines):
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict) or "ev" not in rec:
+                raise ValueError("not a telemetry record")
+        except ValueError as e:
+            if i == len(lines) - 1:
+                notes.append(f"dropped torn final line (crash artifact): "
+                             f"{line[:40]!r}")
+                break
+            problems.append(f"line {i + 1}: unparseable record: {e}")
+            continue
+        records.append(rec)
+    p2, n2 = _check_schema(records)
+    return records, problems + p2, notes + n2
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_schema(records: list[dict]) -> tuple[list[str], list[str]]:
+    """(problems, notes).  A dangling span parent is a PROBLEM in a
+    finished stream (it has an ``end`` record — the sink closed cleanly,
+    so every parent must have recorded) but only a NOTE in a truncated
+    one: children record at exit before their still-open ancestors, so a
+    crash mid-span legitimately orphans them (the same tolerance as the
+    torn final line)."""
+    problems: list[str] = []
+    notes: list[str] = []
+    if not records:
+        return ["empty stream (no records)"], notes
+    finished = any(r.get("ev") == "end" for r in records)
+    span_ids: set[int] = set()
+    for r in records:
+        if r.get("ev") == "span":
+            sid = r.get("id")
+            if isinstance(sid, int):
+                if sid in span_ids:
+                    problems.append(f"duplicate span id {sid}")
+                span_ids.add(sid)
+    for i, r in enumerate(records, 1):
+        ev = r["ev"]
+        if ev not in EVENT_KINDS:
+            problems.append(f"record {i}: unknown ev kind {ev!r}")
+            continue
+        if ev == "meta":
+            if i != 1:
+                problems.append(f"record {i}: meta must be the first record")
+            schema = r.get("schema")
+            if not isinstance(schema, int) or schema > SCHEMA_VERSION:
+                problems.append(
+                    f"record {i}: schema {schema!r} is newer than this "
+                    f"reader understands ({SCHEMA_VERSION})")
+            continue
+        if i == 1:
+            problems.append("record 1: stream must start with a meta record")
+        if ev == "span":
+            if not isinstance(r.get("name"), str) or not r.get("name"):
+                problems.append(f"record {i}: span without a name")
+            if not isinstance(r.get("id"), int):
+                problems.append(f"record {i}: span without an integer id")
+            if not _is_num(r.get("t")) or not _is_num(r.get("dur")) \
+                    or r.get("dur", 0) < 0:
+                problems.append(f"record {i}: span needs numeric t and "
+                                "non-negative dur")
+            par = r.get("parent")
+            if par is not None and par not in span_ids:
+                msg = (f"record {i}: span parent {par!r} matches no span "
+                       "in the stream")
+                if finished:
+                    problems.append(msg)
+                else:
+                    notes.append(msg + " (open ancestor lost to a crash; "
+                                 "aggregating at the root)")
+        elif ev in ("counter", "gauge"):
+            if not isinstance(r.get("name"), str) or not r.get("name"):
+                problems.append(f"record {i}: {ev} without a name")
+            if not _is_num(r.get("value")):
+                problems.append(f"record {i}: {ev} {r.get('name')!r} "
+                                "without a numeric value")
+        elif ev == "event":
+            if not isinstance(r.get("name"), str) or not r.get("name"):
+                problems.append(f"record {i}: event without a name")
+        elif ev == "end":
+            if not _is_num(r.get("dur")):
+                problems.append(f"record {i}: end record without a dur")
+    return problems, notes
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+
+
+class _Node:
+    __slots__ = ("name", "count", "total", "self_s", "children")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.self_s = 0.0
+        self.children: dict[str, _Node] = {}
+
+
+def _span_tree(records: list[dict]) -> _Node:
+    """Aggregate span instances into a name-path tree (root is synthetic).
+
+    Children are emitted before parents (spans record at exit), so paths
+    resolve in a second pass over the id → record map.  A span whose
+    parent never recorded (e.g. torn by a crash) aggregates at the root.
+    """
+    spans = {r["id"]: r for r in records
+             if r.get("ev") == "span" and isinstance(r.get("id"), int)}
+    child_dur: dict[int, float] = {}
+    for r in spans.values():
+        p = r.get("parent")
+        if p is not None:
+            child_dur[p] = child_dur.get(p, 0.0) + float(r.get("dur", 0.0))
+
+    def path_of(r: dict) -> tuple[str, ...]:
+        names: list[str] = []
+        seen: set[int] = set()
+        cur: dict | None = r
+        while cur is not None and cur["id"] not in seen:
+            seen.add(cur["id"])
+            names.append(str(cur.get("name", "?")))
+            cur = spans.get(cur.get("parent"))
+        return tuple(reversed(names))
+
+    root = _Node("")
+    for r in spans.values():
+        node = root
+        for name in path_of(r):
+            node = node.children.setdefault(name, _Node(name))
+        node.count += 1
+        dur = float(r.get("dur", 0.0))
+        node.total += dur
+        node.self_s += max(0.0, dur - child_dur.get(r["id"], 0.0))
+    return root
+
+
+def _metric_rollup(records: list[dict], kind: str) -> dict[str, float]:
+    """Last value per name (counter records are cumulative snapshots)."""
+    out: dict[str, float] = {}
+    for r in records:
+        if r.get("ev") == kind and isinstance(r.get("name"), str) \
+                and _is_num(r.get("value")):
+            out[r["name"]] = float(r["value"])
+    return out
+
+
+def _fmt_val(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else f"{v:.6g}"
+
+
+def _render_spans(node: _Node, lines: list[str], depth: int) -> None:
+    kids = sorted(node.children.values(),
+                  key=lambda n: (-n.total, n.name))
+    for k in kids:
+        label = "  " + ". " * depth + k.name
+        lines.append(f"{label:<44} {k.count:>5}  {k.total:>9.3f}s "
+                     f"{k.self_s:>9.3f}s")
+        _render_spans(k, lines, depth + 1)
+
+
+def trace_breakdown(counters: dict[str, float],
+                    wall: float | None) -> list[str]:
+    """The feed-bound diagnosis block: stall / h2d / device / checkpoint
+    buckets vs the replay's wall time.  Empty when the trace counters are
+    absent from the stream."""
+    buckets = [("reader prefetch stall", "trace.prefetch_stall_s"),
+               ("h2d staging", "trace.h2d_s"),
+               ("device compute", "trace.device_s"),
+               ("checkpoint saves", "trace.ckpt_save_s"),
+               ("table growth", "trace.grow_s")]
+    if not any(k in counters for _, k in buckets):
+        return []
+    lines = ["trace replay breakdown:"]
+    if wall is not None:
+        lines.append(f"  {'wall (trace.replay_file span)':<28} {wall:>9.3f}s")
+    accounted = 0.0
+    for label, key in buckets:
+        if key not in counters:
+            continue
+        v = counters[key]
+        accounted += v
+        pct = f"  {100.0 * v / wall:>5.1f}%" if wall else ""
+        extra = ""
+        if key == "trace.device_s" and counters.get("trace.batches"):
+            nb = counters["trace.batches"]
+            extra = f"  ({v / nb:.4f}s/batch over {int(nb)} batches)"
+        lines.append(f"  {label:<28} {v:>9.3f}s{pct}{extra}")
+    if wall:
+        lines.append(f"  {'accounted':<28} {accounted:>9.3f}s of "
+                     f"{wall:.3f}s wall ({100.0 * accounted / wall:.1f}%)")
+    h2d_b, h2d_s = counters.get("trace.h2d_bytes"), counters.get("trace.h2d_s")
+    if h2d_b and h2d_s:
+        lines.append(f"  {'h2d rate':<28} {h2d_b / 1e6 / h2d_s:>9.1f} MB/s")
+    if counters.get("trace.refs_replayed") and wall:
+        lines.append(f"  {'replay rate':<28} "
+                     f"{counters['trace.refs_replayed'] / wall:>9.3g} refs/s")
+    return lines
+
+
+def render(records: list[dict], out) -> None:
+    """Write the human report for one loaded stream."""
+    n_spans = sum(1 for r in records if r.get("ev") == "span")
+    n_events = sum(1 for r in records if r.get("ev") == "event")
+    finished = any(r.get("ev") == "end" for r in records)
+    out.write(f"telemetry stream: {len(records)} records, {n_spans} "
+              f"span(s), {n_events} event(s)"
+              + ("" if finished else "  [no end record: stream truncated]")
+              + "\n")
+    root = _span_tree(records)
+    if root.children:
+        lines = [f"  {'span':<42} {'n':>5}  {'total':>10} {'self':>10}"]
+        _render_spans(root, lines, 0)
+        out.write("spans:\n" + "\n".join(lines) + "\n")
+    ev_counts: dict[str, int] = {}
+    for r in records:
+        if r.get("ev") == "event" and isinstance(r.get("name"), str):
+            ev_counts[r["name"]] = ev_counts.get(r["name"], 0) + 1
+    if ev_counts:
+        out.write("events:\n")
+        for name in sorted(ev_counts):
+            out.write(f"  {name:<42} {ev_counts[name]:>7}\n")
+    counters = _metric_rollup(records, "counter")
+    if counters:
+        out.write("counters:\n")
+        for name in sorted(counters):
+            out.write(f"  {name:<42} {_fmt_val(counters[name]):>12}\n")
+    gauges = _metric_rollup(records, "gauge")
+    if gauges:
+        out.write("gauges (last value):\n")
+        for name in sorted(gauges):
+            out.write(f"  {name:<42} {_fmt_val(gauges[name]):>12}\n")
+    replay = root.children.get("trace.replay_file")
+    wall = replay.total if replay is not None else None
+    block = trace_breakdown(counters, wall)
+    if block:
+        out.write("\n".join(block) + "\n")
+
+
+def main(path: str, out, err, check: bool = False) -> int:
+    """Entry point behind ``pluss stats <events.jsonl> [--check]``."""
+    import os
+
+    if not os.path.exists(path):
+        err.write(f"pluss stats: no such file: {path}\n")
+        return 2
+    records, problems, notes = load(path)
+    for n in notes:
+        err.write(f"pluss stats: note: {n}\n")
+    if check:
+        for p in problems:
+            err.write(f"pluss stats: {path}: {p}\n")
+        if problems:
+            err.write(f"pluss stats: {path}: {len(problems)} schema "
+                      "violation(s)\n")
+            return 1
+        out.write(f"pluss stats: {path}: ok "
+                  f"({len(records)} records)\n")
+        return 0
+    if problems:
+        for p in problems:
+            err.write(f"pluss stats: {path}: {p}\n")
+    render(records, out)
+    return 0

@@ -1,0 +1,85 @@
+"""Optional xprof integration: jax.profiler sessions + trace annotations.
+
+``PLUSS_XPROF=<dir>`` arms both halves:
+
+- :func:`session` — a refcounted ``jax.profiler.start_trace(dir)`` /
+  ``stop_trace()`` pair around a top-level operation (engine run, trace
+  replay, the CLI's timed region).  Refcounted because sessions cannot
+  nest (``sweep`` runs ``engine.run`` inside its own scope): only the
+  outermost enter starts the profiler, only the outermost exit stops it
+  and dumps the xprof trace into the directory (view with ``tensorboard
+  --logdir <dir>`` or xprof).
+- :func:`annotate` — a named ``jax.profiler.TraceAnnotation`` around one
+  dispatch, so the device timeline labels each batch/slice with the
+  pluss-level operation that issued it.
+
+With the env var unset both are near-free no-ops (one ``environ.get`` +
+``None`` check), and any profiler failure degrades to a no-op with one
+stderr notice — observability must never sink the run it observes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+
+_lock = threading.Lock()
+_depth = 0
+_broken = False
+#: module state, not per-frame: with overlapping sessions on different
+#: threads exiting non-LIFO, the frame that drops _depth to 0 need not be
+#: the frame that started the profiler — whoever reaches 0 stops it
+_started = False
+
+
+def _dir() -> str | None:
+    return os.environ.get("PLUSS_XPROF") or None
+
+
+def enabled() -> bool:
+    return _dir() is not None and not _broken
+
+
+@contextlib.contextmanager
+def session():
+    """Profile the enclosed region into ``$PLUSS_XPROF`` (outermost wins)."""
+    global _depth, _broken, _started
+    d = _dir()
+    if d is None or _broken:
+        yield
+        return
+    import jax
+
+    with _lock:
+        _depth += 1
+        if _depth == 1 and not _started:
+            try:
+                jax.profiler.start_trace(d)
+                _started = True
+            except Exception as e:  # profiler wedged: degrade, don't sink
+                _broken = True
+                print(f"xprof: start_trace({d}) failed, disabling "
+                      f"profiling: {e}", file=sys.stderr)
+    try:
+        yield
+    finally:
+        with _lock:
+            _depth -= 1
+            if _depth == 0 and _started:
+                _started = False
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    _broken = True
+                    print(f"xprof: stop_trace failed: {e}", file=sys.stderr)
+
+
+def annotate(name: str):
+    """Named TraceAnnotation context for one dispatch (no-op when off)."""
+    if _dir() is None or _broken:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

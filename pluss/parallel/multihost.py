@@ -42,8 +42,28 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from pluss import obs
 from pluss.resilience.errors import WorkerDied, classify
 from pluss.resilience import faults
+from pluss.utils import envknob
+
+
+def heartbeat_interval_s() -> float:
+    """File-heartbeat write period: ``PLUSS_HEARTBEAT_S`` (default 0.5 s).
+    Real clusters on NFS/GCS-fuse want seconds, local tests sub-second —
+    the ROADMAP PR-2 follow-up knob, now config instead of a constant.
+    Lenient warn-once parse (:mod:`pluss.utils.envknob`): a typo'd knob
+    on one worker must not crash a pod bring-up."""
+    return envknob.env_float("PLUSS_HEARTBEAT_S", 0.5, 0.01)
+
+
+def heartbeat_timeout_s() -> float:
+    """Staleness threshold for declaring a worker dead:
+    ``PLUSS_HEARTBEAT_TIMEOUT_S`` (default 5 s, and never below 2
+    heartbeat intervals — a timeout tighter than the beat period would
+    declare every healthy worker dead)."""
+    v = envknob.env_float("PLUSS_HEARTBEAT_TIMEOUT_S", 5.0, 0.05)
+    return max(v, 2 * heartbeat_interval_s())
 
 
 def initialize(coordinator_address: str | None = None,
@@ -64,33 +84,88 @@ def initialize(coordinator_address: str | None = None,
     :class:`~pluss.resilience.errors.CollectiveError` naming the attempt
     count instead of a raw RPC exception.
     """
+    from pluss.obs import telemetry as obs_telemetry
+
+    if process_id is not None and process_id != 0 \
+            and os.environ.get("PLUSS_TELEMETRY"):
+        # explicit bring-up names this worker's index up front: re-aim
+        # its telemetry sink NOW, before anything below (including the
+        # chaos injector's fault counters) can lazily bootstrap the
+        # SHARED coordinator path and truncate the coordinator's stream
+        obs.configure(f"{os.environ['PLUSS_TELEMETRY']}.p{process_id}")
+    # auto-detected clusters don't know their index until init completes:
+    # HOLD the lazy env bootstrap through bring-up (pre-init telemetry —
+    # e.g. a chaos fault at multihost.init — is dropped rather than
+    # truncating the shared path), then re-aim and resume
+    suspend = process_id is None and not obs_telemetry.configured() \
+        and bool(os.environ.get("PLUSS_TELEMETRY"))
+    if suspend:
+        obs_telemetry.suspend_env_bootstrap()
     kwargs = dict(coordinator_address=coordinator_address,
                   num_processes=num_processes, process_id=process_id)
     last: BaseException | None = None
-    for attempt in range(max_retries):
-        try:
-            faults.check("multihost.init")   # chaos injection site
+    t_init = time.monotonic()
+    try:
+        for attempt in range(max_retries):
             try:
-                jax.distributed.initialize(
-                    initialization_timeout=int(connect_timeout_s), **kwargs)
-            except TypeError:
-                # older jax: no initialization_timeout parameter
-                jax.distributed.initialize(**kwargs)
-            return
-        except BaseException as e:  # noqa: BLE001 — classified below
-            if isinstance(e, (KeyboardInterrupt, SystemExit)):
-                raise
-            last = e
-            if attempt + 1 < max_retries:
-                delay = backoff_s * (2 ** attempt)
-                print(f"multihost: initialize attempt {attempt + 1}/"
-                      f"{max_retries} failed ({e}); retrying in "
-                      f"{delay:.1f}s", flush=True)
-                time.sleep(delay)
+                faults.check("multihost.init")   # chaos injection site
+                try:
+                    jax.distributed.initialize(
+                        initialization_timeout=int(connect_timeout_s),
+                        **kwargs)
+                except TypeError:
+                    # older jax: no initialization_timeout parameter
+                    jax.distributed.initialize(**kwargs)
+                if suspend:
+                    suspend = False
+                    obs_telemetry.resume_env_bootstrap()
+                # per-process telemetry sink FIRST (before this function's
+                # own counters can bootstrap a shared-path session), then
+                # the bring-up metrics
+                _per_process_sink()
+                obs.counter_add("multihost.init_attempts", attempt + 1)
+                obs.counter_add("multihost.init_s",
+                                time.monotonic() - t_init)
+                return
+            except BaseException as e:  # noqa: BLE001 — classified below
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    raise
+                last = e
+                if attempt + 1 < max_retries:
+                    delay = backoff_s * (2 ** attempt)
+                    print(f"multihost: initialize attempt {attempt + 1}/"
+                          f"{max_retries} failed ({e}); retrying in "
+                          f"{delay:.1f}s", flush=True)
+                    time.sleep(delay)
+    finally:
+        if suspend:
+            obs_telemetry.resume_env_bootstrap()
     err = classify(last, site="multihost.init")
     err.args = (f"distributed initialize failed after {max_retries} "
                 f"attempts: {err.args[0]}",)
     raise err
+
+
+def _per_process_sink() -> None:
+    """Give every non-coordinator process its own telemetry file.
+
+    The sink truncates its path on open, so N workers inheriting one
+    ``PLUSS_TELEMETRY`` path would clobber each other's (and the
+    coordinator's) stream.  Called right after ``jax.distributed``
+    bring-up — before any telemetry in this process has bootstrapped, as
+    long as the caller follows the documented order (initialize first) —
+    it re-aims process ``i > 0`` at ``<path>.p<i>``; the coordinator
+    keeps the bare path, so ``pluss stats <path>`` reads the
+    coordinator's stream as before.
+    """
+    path = os.environ.get("PLUSS_TELEMETRY")
+    if not path or jax.process_count() <= 1 or jax.process_index() == 0:
+        return
+    target = f"{path}.p{jax.process_index()}"
+    tel = obs.active()
+    if tel is not None and tel.path == target:
+        return   # already re-aimed (explicit process_id at bring-up)
+    obs.configure(target)
 
 
 def global_mesh(axis: str = "d") -> Mesh:
@@ -122,13 +197,15 @@ def _hb_path(hb_dir: str, process_index: int) -> str:
 
 
 def start_heartbeat(hb_dir: str, process_index: int | None = None,
-                    interval_s: float = 0.5):
+                    interval_s: float | None = None):
     """Write ``hb.<i>.json`` every ``interval_s`` from a daemon thread.
 
-    Returns a zero-argument ``stop()`` callable.  The beat payload carries
-    a monotonic-ish wall timestamp and the beat count; staleness is judged
-    by :func:`dead_workers` against file mtime, so clock skew between
-    hosts only matters at shared-filesystem granularity.
+    ``interval_s`` defaults to :func:`heartbeat_interval_s`
+    (``PLUSS_HEARTBEAT_S``, 0.5 s).  Returns a zero-argument ``stop()``
+    callable.  The beat payload carries a monotonic-ish wall timestamp and
+    the beat count; staleness is judged by :func:`dead_workers` against
+    file mtime, so clock skew between hosts only matters at
+    shared-filesystem granularity.
 
     This is also the ``kill_worker`` chaos site: a fault plan entry
     ``kill_worker@i`` hard-exits process ``i`` from inside its heartbeat
@@ -136,6 +213,8 @@ def start_heartbeat(hb_dir: str, process_index: int | None = None,
     OOM-killed worker).
     """
     pid = jax.process_index() if process_index is None else process_index
+    if interval_s is None:
+        interval_s = heartbeat_interval_s()
     os.makedirs(hb_dir, exist_ok=True)
     stop = threading.Event()
 
@@ -164,15 +243,39 @@ def start_heartbeat(hb_dir: str, process_index: int | None = None,
     return stopper
 
 
+#: last heartbeat-age gauge publication (monotonic): watchdogs poll
+#: dead_workers at 4 Hz for the whole run, so gauges are sampled at most
+#: once per beat interval — liveness VERDICTS stay per-poll, only the
+#: telemetry sampling is throttled (58k flushed records per half-hour run
+#: otherwise, plausibly onto NFS)
+_last_age_gauge = 0.0
+
+
 def dead_workers(hb_dir: str, num_processes: int,
-                 stale_s: float = 5.0) -> list[int]:
-    """Process indices whose heartbeat is missing or older than ``stale_s``.
+                 stale_s: float | None = None) -> list[int]:
+    """Process indices whose heartbeat is missing or older than ``stale_s``
+    (default :func:`heartbeat_timeout_s`, ``PLUSS_HEARTBEAT_TIMEOUT_S``).
 
     A missing file within the first ``stale_s`` of observation counts as
     dead only after the grace window — callers should begin watching only
     once all workers have beaten at least once (watched_shard_run waits
     for first beats before arming the watchdog).
+
+    Each worker's heartbeat age is published as a telemetry gauge
+    (``multihost.heartbeat_age_s.<i>``; a missing file gauges -1), sampled
+    at most once per beat interval, so liveness is an observable trend,
+    not only a boolean verdict.
     """
+    global _last_age_gauge
+    if stale_s is None:
+        stale_s = heartbeat_timeout_s()
+    obs_on = obs.enabled()
+    if obs_on:
+        mono = time.monotonic()
+        if mono - _last_age_gauge < heartbeat_interval_s():
+            obs_on = False
+        else:
+            _last_age_gauge = mono
     now = time.time()
     dead = []
     for i in range(num_processes):
@@ -180,8 +283,12 @@ def dead_workers(hb_dir: str, num_processes: int,
         try:
             age = now - os.path.getmtime(p)
         except OSError:
+            if obs_on:
+                obs.gauge_set(f"multihost.heartbeat_age_s.{i}", -1.0)
             dead.append(i)
             continue
+        if obs_on:
+            obs.gauge_set(f"multihost.heartbeat_age_s.{i}", round(age, 3))
         if age > stale_s:
             dead.append(i)
     return dead
@@ -190,7 +297,8 @@ def dead_workers(hb_dir: str, num_processes: int,
 def watched_shard_run(spec, cfg=None, share_cap: int | None = None,
                       mesh: Mesh | None = None, *,
                       hb_dir: str, num_processes: int | None = None,
-                      timeout_s: float = 60.0, stale_s: float = 5.0,
+                      timeout_s: float = 60.0,
+                      stale_s: float | None = None,
                       first_beat_timeout_s: float = 30.0,
                       salvage: bool = True, **kw):
     """``shard_run`` under a worker-death watchdog.
@@ -216,11 +324,19 @@ def watched_shard_run(spec, cfg=None, share_cap: int | None = None,
     cfg = cfg if cfg is not None else DEFAULT
     share_cap = share_cap or SHARE_CAP
     nproc = num_processes or process_count()
+    if stale_s is None:
+        stale_s = heartbeat_timeout_s()
     box: dict = {}
 
     def target() -> None:
+        t0 = time.monotonic()
         try:
             box["res"] = shard_run(spec, cfg, share_cap, mesh, **kw)
+            # the SPMD wall clock — collectives included — of the watched
+            # run; a hung collective never records one (the span + death
+            # event carry that story instead)
+            obs.counter_add("multihost.shard_run_s",
+                            time.monotonic() - t0)
         except BaseException as e:  # noqa: BLE001 — classified by consumer
             box["err"] = e
 
@@ -269,9 +385,13 @@ def watched_shard_run(spec, cfg=None, share_cap: int | None = None,
         f"worker(s) {dead or '<unknown>'} stopped heartbeating; "
         f"abandoning the hung collective", site="multihost.watch",
         process_ids=tuple(dead))
+    obs.counter_add("multihost.worker_deaths", max(1, len(dead)))
+    obs.event("multihost.worker_died", processes=list(dead),
+              model=getattr(spec, "name", "?"))
     if salvage and is_coordinator():
         print(f"multihost: {err}; salvaging in a clean subprocess",
               flush=True)
+        obs.counter_add("multihost.salvages")
         res = _salvage_subprocess(spec, cfg, share_cap,
                                   kw.get("window_accesses"),
                                   kw.get("assignment"),
@@ -330,9 +450,13 @@ def _salvage_subprocess(spec, cfg, share_cap: int,
         env = {**os.environ, "JAX_PLATFORMS": "cpu",
                "PYTHONPATH": repo + os.pathsep
                + os.environ.get("PYTHONPATH", "")}
-        # the child must NOT rejoin the dead cluster
+        # the child must NOT rejoin the dead cluster — and must not open
+        # the coordinator's LIVE telemetry sink (Telemetry truncates its
+        # path on open: the child would destroy the very stream recording
+        # this salvage) or its profiler session
         for var in ("JAX_COORDINATOR_ADDRESS", "XLA_FLAGS",
-                    "PLUSS_FAULT_PLAN"):
+                    "PLUSS_FAULT_PLAN", "PLUSS_TELEMETRY", "PLUSS_PROM",
+                    "PLUSS_XPROF"):
             env.pop(var, None)
         proc = subprocess.run(
             [sys.executable, "-c", code, inp, outp],

@@ -132,6 +132,7 @@ class FaultPlan:
             if e is not None:
                 e.fired = False  # re-arm: corrupt() consumes it
             return
+        _record_fired(e, site)
         tag = f"(injected {e.kind}@{e.n} at {site})"
         if e.kind in ("oom", "shard_oom"):
             raise RuntimeError(
@@ -161,6 +162,7 @@ class FaultPlan:
                 if os.path.exists(path):
                     with open(path, "r+b") as f:
                         f.write(b"\x00CHAOS\x00")  # clobber the pickle magic
+                    _record_fired(e, site)
                     return True
         return False
 
@@ -171,8 +173,20 @@ class FaultPlan:
             if not e.fired and e.kind == "kill_worker" and e.site == site \
                     and e.n == process_index:
                 e.fired = True
+                _record_fired(e, site)
                 return True
         return False
+
+
+def _record_fired(e: _Entry, site: str) -> None:
+    """Telemetry of one injected fault actually firing — paired with the
+    ladder's rung-transition events, the chaos record answers 'what was
+    injected vs what recovery actually ran' from the stream alone."""
+    from pluss import obs
+
+    obs.counter_add("resilience.faults_fired")
+    obs.counter_add(f"resilience.faults_fired.{e.kind}")
+    obs.event("resilience.fault_injected", kind=e.kind, site=site, n=e.n)
 
 
 # ---------------------------------------------------------------------------

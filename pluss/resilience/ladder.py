@@ -128,11 +128,14 @@ def _resilient_loop(make_attempt, apply_rung, rungs: tuple[str, ...],
                 _log(f"{label}: retry budget ({retry.max_attempts}) "
                      f"exhausted at {err}")
                 raise err
+            from pluss import obs
+
             if isinstance(err, ShareCapOverflow):
                 new_cap = _next_share_cap(err, state.get("share_cap", 0)
                                           or err.needed)
                 state["share_cap"] = new_cap
                 degradations.append(f"share_cap={new_cap}")
+                obs.counter_add("resilience.share_cap_raises")
                 _log(f"{label}: share cap overflow ({err.needed} uniques); "
                      f"retrying at cap {new_cap}")
             elif err.degradable and rung_idx < len(rungs):
@@ -140,9 +143,14 @@ def _resilient_loop(make_attempt, apply_rung, rungs: tuple[str, ...],
                 rung_idx += 1
                 apply_rung(state, rung)
                 degradations.append(rung)
+                obs.counter_add("resilience.rungs_taken")
+                obs.counter_add(f"resilience.rungs_taken.{rung}")
+                obs.event("resilience.rung", rung=rung, label=label,
+                          error=type(err).__name__)
                 _log(f"{label}: {type(err).__name__} at "
                      f"{err.site or label}; degrading -> {rung}")
             elif err.retryable:
+                obs.counter_add("resilience.retries")
                 _log(f"{label}: transient {type(err).__name__}; "
                      f"retry {retries}/{retry.max_attempts}")
             else:

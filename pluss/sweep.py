@@ -81,6 +81,7 @@ def sweep(spec: LoopNestSpec,
     CRI + AET tail is deterministic host math and replays in
     milliseconds), stamped ``degradations=('journal',) + <original>``.
     """
+    from pluss import obs
     from pluss.resilience import run_resilient
     from pluss.resilience.journal import Journal
 
@@ -91,27 +92,35 @@ def sweep(spec: LoopNestSpec,
         for cs in chunk_sizes:
             cfg = dataclasses.replace(base_cfg, thread_num=t, chunk_size=cs)
             key = _point_key(spec, cfg)
-            rec = journal.get(key) if (journal is not None and resume) \
-                else None
-            if rec is not None:
-                noshare = [_intkeys(d) for d in rec["noshare"]]
-                share = [{int(r): _intkeys(h) for r, h in d.items()}
-                         for d in rec["share"]]
-                refs = rec["refs"]
-                degradations = ("journal",) + tuple(rec.get(
-                    "degradations", ()))
-            else:
-                res = run_resilient(spec, cfg, share_cap)
-                noshare, share = res.noshare_list(), res.share_list()
-                refs = res.max_iteration_count
-                degradations = tuple(res.degradations)
-                if journal is not None:
-                    journal.record(key, noshare=noshare, share=share,
-                                   refs=refs,
-                                   degradations=list(degradations))
-            ri = cri.distribute(noshare, share, t)
-            out.append(SweepPoint(cfg, mrc.aet_mrc(ri, cfg), refs,
-                                  degradations))
+            # one span per point, restored-from-journal or computed — the
+            # per-point timings `pluss stats` rolls up to show where a
+            # multi-config sweep's wall clock actually went
+            with obs.span("sweep.point", model=spec.name, threads=t,
+                          chunk=cs) as sp:
+                rec = journal.get(key) if (journal is not None and resume) \
+                    else None
+                if rec is not None:
+                    noshare = [_intkeys(d) for d in rec["noshare"]]
+                    share = [{int(r): _intkeys(h) for r, h in d.items()}
+                             for d in rec["share"]]
+                    refs = rec["refs"]
+                    degradations = ("journal",) + tuple(rec.get(
+                        "degradations", ()))
+                    obs.counter_add("sweep.points_restored")
+                else:
+                    res = run_resilient(spec, cfg, share_cap)
+                    noshare, share = res.noshare_list(), res.share_list()
+                    refs = res.max_iteration_count
+                    degradations = tuple(res.degradations)
+                    if journal is not None:
+                        journal.record(key, noshare=noshare, share=share,
+                                       refs=refs,
+                                       degradations=list(degradations))
+                    obs.counter_add("sweep.points_run")
+                sp.set(refs=refs, restored=rec is not None)
+                ri = cri.distribute(noshare, share, t)
+                out.append(SweepPoint(cfg, mrc.aet_mrc(ri, cfg), refs,
+                                      degradations))
     return out
 
 

@@ -43,7 +43,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pluss import obs
 from pluss.config import NBINS
+from pluss.obs import xprof
 from pluss.ops.reuse import (
     batch_events,
     bin_histogram,
@@ -91,29 +93,14 @@ class ReplayResult:
 
 
 def _env_int(name: str, default: int, minimum: int = 1) -> int:
-    """An integer env knob, parsed leniently: a malformed or out-of-range
-    value must not crash an import or abort an hours-long replay mid-run —
-    warn (naming the env var, so the operator knows where to act) and
-    fall back to the default instead.  Explicit kwargs keep their loud
+    """An integer env knob, parsed leniently (warn + fall back, never
+    crash an import or an hours-long replay) — the shared policy lives
+    in :mod:`pluss.utils.envknob`.  Explicit kwargs keep their loud
     validation at the use sites (:func:`_resolve_bw`, the queue-depth
     check)."""
-    raw = os.environ.get(name, "")
-    if raw.strip():
-        import sys
+    from pluss.utils.envknob import env_int
 
-        try:
-            v = int(raw)
-        except ValueError:
-            print(f"trace: ignoring malformed {name}={raw!r}; "
-                  f"using the default {default}", file=sys.stderr)
-            return default
-        if v < minimum:
-            print(f"trace: ignoring out-of-range {name}={raw!r} (must be "
-                  f">= {minimum}); using the default {default}",
-                  file=sys.stderr)
-            return default
-        return v
-    return default
+    return env_int(name, default, minimum)
 
 
 #: default windows shipped to the device per batch; one compile serves a
@@ -193,6 +180,12 @@ class _threaded:
         self._t.start()
         return self
 
+    def qsize(self) -> int:
+        """Instantaneous queue occupancy (telemetry gauge: a persistently
+        EMPTY queue means the consumer is starved — the feed is the
+        bottleneck; persistently full means the device is)."""
+        return self._q.qsize()
+
     def __iter__(self):
         return self
 
@@ -208,6 +201,14 @@ class _threaded:
         self._stop.set()
         self._t.join(timeout=60)
         return False
+
+
+#: packed-trace wire-format version, stamped in pack_file's sidecar.  Bump
+#: whenever the on-disk id encoding (u16/u24/i32 packing, byte order, the
+#: compaction semantics feeding it) changes meaning — consumers that cache
+#: packed traces across runs (bench.py) key on it so a stale pack from an
+#: older format can never be replayed silently.
+WIRE_VERSION = 1
 
 
 def _pack24(ids: np.ndarray) -> np.ndarray:
@@ -780,6 +781,18 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
         hist = jnp.zeros((NBINS,), pdt)
         n_lines = 0
         done = 0
+    done0 = done   # checkpoint-restored refs: not THIS run's work
+
+    # structured loop accounting (replaces the old ad-hoc t0 locals): the
+    # main thread is, at any instant, in exactly one of these buckets, so
+    # their sum accounts for the replay's wall clock — `pluss stats`
+    # renders the breakdown and the feed-bound diagnosis reads off it.
+    # Accumulated locally either way (a handful of perf_counter calls per
+    # multi-M-ref batch); recorded only when telemetry is enabled.
+    st = {"prefetch_stall_s": 0.0, "h2d_s": 0.0, "device_s": 0.0,
+          "ckpt_save_s": 0.0, "grow_s": 0.0}
+    st_n = {"h2d_bytes": 0, "batches": 0, "ckpt_saves": 0, "growths": 0}
+    obs_on = obs.enabled()
 
     def stage(item):
         """Start one packed batch's h2d transfer NOW.  ``device_put`` is
@@ -790,54 +803,114 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
             return None
         ids, n_lines_b, snap_b = item
         shaped = ids.reshape((bw, window) + ids.shape[1:])
-        return jax.device_put(shaped), n_lines_b, snap_b
+        return jax.device_put(shaped), n_lines_b, snap_b, ids.nbytes
 
-    with src as it:
+    with obs.span("trace.replay_file", refs=n, window=window,
+                  batch_windows=bw, resume_batch=b0) as sp, \
+            xprof.session(), src as it:
         stream = iter(it)
-        nxt = stage(next(stream, None))
-        b = b0
-        while nxt is not None:
-            ids_dev, n_lines, snap = nxt
-            if n_lines > capacity:
-                while capacity < n_lines:
-                    capacity *= 2
-                last_pos = jnp.concatenate(
-                    [last_pos, jnp.full((capacity - last_pos.shape[0],),
-                                        -1, pdt)]
-                )
-            last_pos, hist = fn(
-                last_pos, hist, pdt.type(b * batch), ids_dev, pdt.type(n),
-            )
-            done = min(n, (b + 1) * batch)
-            if checkpoint_path and done < n \
-                    and (b + 1 - b0) % checkpoint_every == 0:
-                # the d2h fetch synchronizes the dispatch queue — that is
-                # the price of a durable point; checkpoint_every amortizes.
-                # The save runs BEFORE the next prefetch: a reader fault
-                # in batch b+1 must never cost batch b's durable point
-                _ckpt_save(checkpoint_path, b + 1, n, window, cls,
-                           precompacted, fp, last_pos, hist, snap, bw)
-            # the cheap unsynced clock runs every batch; the device sync
-            # (which is what makes the elapsed time REAL under async
-            # dispatch) is only paid once the unsynced time is already
-            # over — so a fast run never syncs, and a slow feed cannot
-            # overshoot by more than one batch
-            if deadline_s is not None and done < n \
-                    and _time.perf_counter() - t0 > deadline_s:
-                np.asarray(hist[:1])
-                if _time.perf_counter() - t0 > deadline_s:
-                    # truncation is clean at a batch boundary: every
-                    # processed position is < done, none beyond dispatched
-                    break
-            # double buffering: the NEXT batch's device_put is dispatched
-            # while this batch's kernel runs (dispatch above is async; the
-            # checkpoint branch is a no-op on all but every
-            # checkpoint_every-th batch), so the h2d feed and the scan
-            # overlap instead of being paid serially.  A dropped in-flight
-            # prefetch at a deadline break is harmless — it never
-            # dispatches compute
-            nxt = stage(next(stream, None))
-            b += 1
+
+        def fetch_next():
+            """Pull + stage the next batch, splitting time blocked on the
+            reader thread (prefetch stall: the feed is behind) from time
+            spent handing bytes to the device (h2d staging dispatch)."""
+            t1 = _time.perf_counter()
+            item = next(stream, None)
+            t2 = _time.perf_counter()
+            st["prefetch_stall_s"] += t2 - t1
+            out = stage(item)
+            st["h2d_s"] += _time.perf_counter() - t2
+            if out is not None:
+                st_n["h2d_bytes"] += out[3]
+            return out
+
+        try:
+            nxt = fetch_next()
+            b = b0
+            while nxt is not None:
+                ids_dev, n_lines, snap, _ = nxt
+                if n_lines > capacity:
+                    tg = _time.perf_counter()
+                    while capacity < n_lines:
+                        capacity *= 2
+                    last_pos = jnp.concatenate(
+                        [last_pos,
+                         jnp.full((capacity - last_pos.shape[0],), -1, pdt)]
+                    )
+                    st["grow_s"] += _time.perf_counter() - tg
+                    st_n["growths"] += 1
+                td = _time.perf_counter()
+                with xprof.annotate("pluss.trace.batch"):
+                    last_pos, hist = fn(
+                        last_pos, hist, pdt.type(b * batch), ids_dev,
+                        pdt.type(n),
+                    )
+                st["device_s"] += _time.perf_counter() - td
+                st_n["batches"] += 1
+                if obs_on and pipeline:
+                    obs.gauge_set("trace.queue_occupancy", it.qsize())
+                done = min(n, (b + 1) * batch)
+                if checkpoint_path and done < n \
+                        and (b + 1 - b0) % checkpoint_every == 0:
+                    # the d2h fetch synchronizes the dispatch queue — that
+                    # is the price of a durable point; checkpoint_every
+                    # amortizes.  The save runs BEFORE the next prefetch: a
+                    # reader fault in batch b+1 must never cost batch b's
+                    # durable point
+                    tc = _time.perf_counter()
+                    _ckpt_save(checkpoint_path, b + 1, n, window, cls,
+                               precompacted, fp, last_pos, hist, snap, bw)
+                    st["ckpt_save_s"] += _time.perf_counter() - tc
+                    st_n["ckpt_saves"] += 1
+                # the cheap unsynced clock runs every batch; the device
+                # sync (which is what makes the elapsed time REAL under
+                # async dispatch) is only paid once the unsynced time is
+                # already over — so a fast run never syncs, and a slow
+                # feed cannot overshoot by more than one batch
+                if deadline_s is not None and done < n \
+                        and _time.perf_counter() - t0 > deadline_s:
+                    ts = _time.perf_counter()
+                    np.asarray(hist[:1])
+                    st["device_s"] += _time.perf_counter() - ts
+                    if _time.perf_counter() - t0 > deadline_s:
+                        # truncation is clean at a batch boundary: every
+                        # processed position is < done, none beyond
+                        # dispatched
+                        if obs_on:
+                            obs.event("trace.deadline_truncated",
+                                      done=done, refs=n)
+                        break
+                # double buffering: the NEXT batch's device_put is
+                # dispatched while this batch's kernel runs (dispatch
+                # above is async; the checkpoint branch is a no-op on all
+                # but every checkpoint_every-th batch), so the h2d feed
+                # and the scan overlap instead of being paid serially.  A
+                # dropped in-flight prefetch at a deadline break is
+                # harmless — it never dispatches compute
+                nxt = fetch_next()
+                b += 1
+            # the final d2h fetch is what forces every outstanding
+            # dispatch to completion — that wait is device time
+            td = _time.perf_counter()
+            hist_np = np.asarray(hist, np.int64)
+            st["device_s"] += _time.perf_counter() - td
+        finally:
+            # recorded even when the replay aborts mid-stream (an injected
+            # DataLoss, a real read failure): the partial run's breakdown
+            # is exactly what the post-mortem wants to see
+            if obs_on:
+                for k, v in st.items():
+                    obs.counter_add(f"trace.{k}", v)
+                for k, v in st_n.items():
+                    obs.counter_add(f"trace.{k}", v)
+                # only the refs THIS run replayed: a resumed run's span
+                # wall covers the tail after the checkpoint, so counting
+                # the restored prefix would inflate every rate derived
+                # from (refs_replayed / wall)
+                obs.counter_add("trace.refs_replayed", done - done0)
+                sp.set(refs_replayed=done - done0, stream_done=done,
+                       n_lines=n_lines)
+                obs.flush_metrics()
     if checkpoint_path and done >= n:
         # a finished run retires its checkpoint: a later DIFFERENT run
         # must not resume from this one's final state
@@ -845,7 +918,7 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
             os.unlink(checkpoint_path)
         except OSError:
             pass
-    return ReplayResult(np.asarray(hist, np.int64), done, n_lines)
+    return ReplayResult(hist_np, done, n_lines)
 
 
 def pack_file(path: str, out_path: str, cls: int = 64,
@@ -950,7 +1023,8 @@ def pack_file(path: str, out_path: str, cls: int = 64,
         except OSError:
             pass
     journal = Journal(jpath)
-    with open(path, "rb") as f, open(tmp, "r+b" if b0 else "wb") as out:
+    with obs.span("trace.pack_file", refs=n, fmt=fmt, resume_batch=b0), \
+            open(path, "rb") as f, open(tmp, "r+b" if b0 else "wb") as out:
         f.seek(b0 * batch * 8)
         out.seek(0, os.SEEK_END)
         for b in range(b0, n_batches):
@@ -992,13 +1066,18 @@ def pack_file(path: str, out_path: str, cls: int = 64,
                            cls=cls, precompacted=bool(precompacted),
                            fp=fp, fmt=fmt, bw=bw)
     os.replace(tmp, out_path)
-    meta = {"n": n, "n_lines": comp.next_free, "fmt": fmt}
+    # src_fp + wire bind the pack to its source trace's content and this
+    # module's wire-format version: cross-run pack caches (bench.py) key
+    # on them so a regenerated trace or a format change forces a repack
+    meta = {"n": n, "n_lines": comp.next_free, "fmt": fmt,
+            "src_fp": fp, "wire": WIRE_VERSION}
     with open(out_path + ".json", "w") as f:
         json.dump(meta, f)
     try:
         os.unlink(jpath)   # the pack is durable; the journal is spent
     except OSError:
         pass
+    obs.counter_add("trace.pack_refs", n)
     return meta
 
 
@@ -1115,31 +1194,39 @@ def stage_resident(packed_path: str, meta: dict,
     stage = _stage_fn(jax.default_backend())
 
     t0 = time.perf_counter()
-    resident = jnp.zeros((n_batches, bw, window, bpr), jnp.uint8)
-    staged = 0
-    with open(packed_path, "rb") as f:
-        for b in range(n_batches):
-            raw = np.fromfile(f, dtype=np.uint8,
-                              count=min(batch, n - b * batch) * bpr)
-            pad = batch * bpr - len(raw)
-            if pad:
-                raw = np.concatenate([raw, np.zeros(pad, np.uint8)])
-            resident = stage(
-                resident,
-                jnp.asarray(raw.reshape(1, bw, window, bpr)),
-                jnp.int32(b))
-            staged = b + 1
-            if upload_budget_s is not None and staged < n_batches \
-                    and staged % 16 == 0:
-                # transfers are ASYNC: without a periodic sync the loop
-                # finishes in milliseconds and the budget check never sees
-                # real elapsed time (observed: 427s staged past a 300s cap)
-                np.asarray(resident[0, 0, 0, :1])
-                if time.perf_counter() - t0 > upload_budget_s:
-                    break
-    np.asarray(resident[0, 0, 0, :1])  # force staging completion (tiny d2h;
-    # block_until_ready does not actually wait over the tunneled backend)
-    upload_s = time.perf_counter() - t0
+    with obs.span("trace.stage_resident", refs=n, fmt=meta["fmt"],
+                  batch_windows=bw) as sp:
+        resident = jnp.zeros((n_batches, bw, window, bpr), jnp.uint8)
+        staged = 0
+        payload_bytes = 0   # real file bytes, excluding final-batch padding
+        with open(packed_path, "rb") as f:
+            for b in range(n_batches):
+                raw = np.fromfile(f, dtype=np.uint8,
+                                  count=min(batch, n - b * batch) * bpr)
+                payload_bytes += len(raw)
+                pad = batch * bpr - len(raw)
+                if pad:
+                    raw = np.concatenate([raw, np.zeros(pad, np.uint8)])
+                resident = stage(
+                    resident,
+                    jnp.asarray(raw.reshape(1, bw, window, bpr)),
+                    jnp.int32(b))
+                staged = b + 1
+                if upload_budget_s is not None and staged < n_batches \
+                        and staged % 16 == 0:
+                    # transfers are ASYNC: without a periodic sync the loop
+                    # finishes in milliseconds and the budget check never
+                    # sees real elapsed time (observed: 427s staged past a
+                    # 300s cap)
+                    np.asarray(resident[0, 0, 0, :1])
+                    if time.perf_counter() - t0 > upload_budget_s:
+                        break
+        np.asarray(resident[0, 0, 0, :1])  # force staging completion (tiny
+        # d2h; block_until_ready does not actually wait over the tunnel)
+        upload_s = time.perf_counter() - t0
+        sp.set(staged_batches=staged, shrunk=staged < n_batches)
+    obs.counter_add("trace.upload_s", upload_s)
+    obs.counter_add("trace.upload_bytes", payload_bytes)
     if staged < n_batches:
         # budget-shrunk prefix: keep only the staged leading batches
         resident = jax.lax.slice_in_dim(resident, 0, staged, axis=0)
@@ -1173,10 +1260,18 @@ def replay_staged(resident, n_lines: int, n_run: int,
     last_pos = jnp.full((n_lines,), -1, pdt)
     hist = jnp.zeros((NBINS,), pdt)
     t0 = time.perf_counter()
-    last_pos, hist = fn(resident, last_pos, hist,
-                        pdt.type(clock0 + n_run), pdt.type(clock0))
-    hist_np = np.asarray(hist, np.int64)   # d2h forces completion
+    with obs.span("trace.replay_staged", refs=n_run), xprof.session(), \
+            xprof.annotate("pluss.trace.replay_staged"):
+        last_pos, hist = fn(resident, last_pos, hist,
+                            pdt.type(clock0 + n_run), pdt.type(clock0))
+        hist_np = np.asarray(hist, np.int64)   # d2h forces completion
     replay_s = time.perf_counter() - t0
+    # resident refs get their OWN counter: trace.refs_replayed feeds the
+    # streamed-path rate (refs / replay_file span wall) in `pluss stats`,
+    # and one process often runs both paths (bench) — mixing them would
+    # inflate the streamed rate by the resident volume
+    obs.counter_add("trace.resident_replay_s", replay_s)
+    obs.counter_add("trace.resident_refs", n_run)
     if stats is not None:
         stats["replay_s"] = replay_s
         stats["refs"] = n_run

@@ -1,0 +1,44 @@
+"""Lenient, warn-once environment knobs (PLUSS_* tuning variables).
+
+One policy, shared by every layer (trace batching, reader queue depth,
+multihost heartbeats): a malformed or out-of-range value must never
+crash an import, a pod bring-up, or an hours-long run — warn naming the
+variable (so the operator knows where to act) and fall back to the
+default.  Parsing is memoized per (knob, raw value): some knobs are read
+from hot loops (the multihost watchdog polls at ~4 Hz), where
+re-warning every read would spam stderr for the whole run.  Explicit
+kwargs at the call sites keep their loud validation — lenience is for
+the environment only.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+
+def env_int(name: str, default: int, minimum: int = 1) -> int:
+    return _parse(name, os.environ.get(name, ""), default, minimum, int)
+
+
+def env_float(name: str, default: float, minimum: float) -> float:
+    return _parse(name, os.environ.get(name, ""), default, minimum, float)
+
+
+@functools.lru_cache(maxsize=64)
+def _parse(name: str, raw: str, default, minimum, conv):
+    if not raw.strip():
+        return default
+    try:
+        v = conv(raw)
+    except ValueError:
+        print(f"pluss: ignoring malformed {name}={raw!r}; using the "
+              f"default {default}", file=sys.stderr)
+        return default
+    if v < minimum:
+        print(f"pluss: ignoring out-of-range {name}={raw!r} (must be "
+              f">= {minimum}); using the default {default}",
+              file=sys.stderr)
+        return default
+    return v

@@ -24,8 +24,15 @@ python -m pluss.cli analyze --all 1>&2
 # trace replay smoke (tier-1): pack_file → replay_file → fault-interrupted
 # checkpoint --resume equivalence + legacy-kernel A/B on a ~1e6-ref
 # synthetic trace, pinned to the CPU backend (~10 s).  The replay path is
-# exercised on every PR, not just in the budget-gated bench.
-JAX_PLATFORMS=cpu python -m pluss.trace_smoke 1>&2
+# exercised on every PR, not just in the budget-gated bench.  Runs with
+# the telemetry sink ARMED, and the emitted event stream must pass the
+# schema check (`pluss stats --check`) — an observability regression
+# (malformed records, a broken sink) gates the PR like any other.
+PLUSS_OBS_LOG=$(mktemp /tmp/pluss_obs_XXXX.jsonl)
+JAX_PLATFORMS=cpu PLUSS_TELEMETRY="$PLUSS_OBS_LOG" \
+  python -m pluss.trace_smoke 1>&2
+python -m pluss.cli stats "$PLUSS_OBS_LOG" --check 1>&2
+rm -f "$PLUSS_OBS_LOG"
 
 # opt-in chaos smoke (PLUSS_CHAOS=1): a short seeded fault-plan soak on the
 # CPU backend — every injected fault (OOM / compile / share-cap / corrupt

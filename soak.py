@@ -37,11 +37,19 @@ def chaos(n_rounds: int, sd: int) -> int:
 
     force_cpu()
     enable_x64()
-    from pluss import engine
+    from pluss import engine, obs
     from pluss.config import SamplerConfig
     from pluss.models import REGISTRY
     from pluss.resilience import FaultPlan, PlussError, run_resilient
     from pluss.resilience import faults
+
+    # the soak records its own telemetry stream (PLUSS_TELEMETRY overrides
+    # the sink): the summary below — faults fired vs ladder rungs taken —
+    # is read back off the live counters, so it can never drift from what
+    # the injector and the ladder actually recorded
+    if not obs.enabled():
+        obs.configure(os.path.join(os.environ["PLUSS_PLAN_CACHE_DIR"],
+                                   "chaos_telemetry.jsonl"))
 
     pool = [("gemm", 16, SamplerConfig(cls=8)),
             ("syrk", 12, SamplerConfig(cls=8)),
@@ -78,6 +86,24 @@ def chaos(n_rounds: int, sd: int) -> int:
         print(f"chaos[{i}] {name}{n} plan={plan.describe()}: {status}"
               + (f" (degraded: {deg})" if deg else "")
               + f" in {time.perf_counter() - t0:.1f}s", flush=True)
+    c = obs.counters()
+
+    def breakdown(prefix: str) -> str:
+        parts = [f"{k[len(prefix):]}={int(v)}" for k, v in sorted(c.items())
+                 if k.startswith(prefix)]
+        return " (" + ",".join(parts) + ")" if parts else ""
+
+    tel = obs.active()
+    print("chaos telemetry: "
+          f"{int(c.get('resilience.faults_fired', 0))} fault(s) fired"
+          f"{breakdown('resilience.faults_fired.')} vs "
+          f"{int(c.get('resilience.rungs_taken', 0))} ladder rung(s) taken"
+          f"{breakdown('resilience.rungs_taken.')}, "
+          f"{int(c.get('resilience.share_cap_raises', 0))} share-cap "
+          f"raise(s), {int(c.get('resilience.retries', 0))} plain "
+          "retr(y/ies)"
+          + (f"; event stream at {tel.path}" if tel else ""), flush=True)
+    obs.flush_metrics()
     print(f"chaos soak: {n_rounds} rounds, {failures} failure(s), seed {sd}",
           flush=True)
     return 1 if failures else 0
