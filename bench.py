@@ -400,21 +400,29 @@ def cached_pack(path: str, n_refs: int) -> tuple[dict | None, bool, str]:
                 meta = _json.load(f)
         except ValueError:
             meta = {}
+        # d24v is the wanted on-disk format (staging ships the compressed
+        # records; i32 is the >2^24-line fallback pack_file may have
+        # chosen) — and a d24v pack is only stageable at its own batch
+        # grid, so a PLUSS_BATCH_WINDOWS change also forces a repack
+        fmt_ok = meta.get("fmt") == "i32" or (
+            meta.get("fmt") == "d24v"
+            and meta.get("batch") == trace.TRACE_WINDOW
+            * trace.WINDOWS_PER_BATCH)
         if meta.get("n") == n_refs \
                 and meta.get("src_fp") == trace._trace_fingerprint(path) \
-                and meta.get("wire") == trace.WIRE_VERSION:
+                and meta.get("wire") == trace.WIRE_VERSION and fmt_ok:
             log(f"bench: staged trace pack {packed}: cached "
                 f"({meta['n_lines']} line slots, fmt {meta['fmt']})")
             return meta, True, packed
-        log("bench: staged trace pack is stale (source trace or wire "
-            "format changed); repacking")
+        log("bench: staged trace pack is stale (source trace, wire "
+            "format, or batch grid changed); repacking")
     if not budget_ok("trace pack_file (one-time)", 420):
         return None, False, packed
     log(f"bench: packing trace ids (one-time) at {packed}")
     t0 = time.perf_counter()
-    meta = trace.pack_file(path, packed)
+    meta = trace.pack_file(path, packed, wire="d24v")
     log(f"bench: packed in {time.perf_counter() - t0:.1f}s "
-        f"({meta['n_lines']} line slots)")
+        f"({meta['n_lines']} line slots, fmt {meta['fmt']})")
     return meta, False, packed
 
 
@@ -457,6 +465,7 @@ def bench_trace_resident(n_refs: int) -> None:
          refs_replayed=n_run, refs_requested=n_refs,
          shrunk=bool(n_run != n_refs),
          staging_cached=staging_cached,
+         pack_fmt=meta["fmt"],
          upload_s=round(stats["upload_s"], 1),
          upload_mb_s=round(mb / stats["upload_s"], 2))
 
@@ -525,12 +534,24 @@ def bench_trace(n_refs: int) -> None:
             return c1.get(k, 0.0) - c0.get(k, 0.0)
 
         stall, h2d_s = delta("trace.prefetch_stall_s"), delta("trace.h2d_s")
+        wire_b, dev_b = delta("trace.h2d_bytes"), delta("trace.device_bytes")
         obs_extra = {
             "feed_stall_frac": round_keep(stall / best_s, 4),
             "device_frac": round_keep(delta("trace.device_s") / best_s, 4),
-            "h2d_mb_s": round_keep(delta("trace.h2d_bytes") / 1e6 / h2d_s, 2)
+            "h2d_mb_s": round_keep(wire_b / 1e6 / h2d_s, 2)
             if h2d_s > 0 else None,
+            # wire-vs-device compression ratio of the feed (1.33 = the
+            # plain u24 pack; higher = the d24v wire is earning its keep)
+            "wire_ratio": round_keep(dev_b / wire_b, 3) if wire_b else None,
         }
+    # the feed configuration the rate was measured under — read off the
+    # RESULT (replay_file stamps its effective values, surviving ladder
+    # rungs and backend flips), not re-resolved process defaults —
+    # straight on the metric line so the BENCH_r0x trajectory records
+    # the gap-closure setup (not just its outcome)
+    obs_extra["wire"] = rep.wire or trace._resolve_wire(None)
+    obs_extra["feed_workers"] = (rep.feed_workers
+                                 or trace._resolve_feed_workers(None))
     # native replay is linear in refs, so one measured (refs, seconds) pair
     # scales to whatever prefix the feed budget allowed this round
     rate = native_trace_rate(path)

@@ -254,6 +254,19 @@ def main(argv: list[str] | None = None) -> int:
                         "sort-kernel dispatch covers the whole batch, so "
                         "bigger batches amortize dispatch cost; part of "
                         "the checkpoint identity")
+    p.add_argument("--feed-workers", type=int, default=None,
+                   help="trace mode: reader/packer worker threads feeding "
+                        "the replay pipeline (default PLUSS_FEED_WORKERS "
+                        "or backend-aware: 1 on CPU, most host cores on "
+                        "accelerators); must be >= 1")
+    p.add_argument("--wire", default=None,
+                   choices=("auto", "pack", "d24v"),
+                   help="trace mode: h2d wire encoding — pack (fixed-"
+                        "width u16/u24/i32), d24v (delta+zigzag+nibble "
+                        "bit-pack, decoded on device), or auto (default; "
+                        "PLUSS_WIRE env, else d24v on accelerators / "
+                        "pack on CPU).  Histogram-invariant; part of the "
+                        "checkpoint identity")
     p.add_argument("--start-point", type=int, default=None,
                    help="resume sampling from this parallel-loop iteration "
                         "value (the reference's setStartPoint capability)")
@@ -427,11 +440,17 @@ def main(argv: list[str] | None = None) -> int:
         # (which merely contains "shard") must not select it
         t0 = time.perf_counter()
         win = args.window or trace_mod.TRACE_WINDOW
-        # None defers to the module default (PLUSS_BATCH_WINDOWS env or 16);
-        # explicit values — including invalid ones — pass through so the
-        # trace layer's validation rejects them loudly
+        # None defers to the module defaults (PLUSS_BATCH_WINDOWS /
+        # PLUSS_FEED_WORKERS / PLUSS_WIRE envs); explicit values —
+        # including invalid ones — pass through so the trace layer's
+        # validation rejects them loudly
         bw_kw = {"batch_windows": args.batch_windows} \
             if args.batch_windows is not None else {}
+        feed_kw = {}
+        if args.feed_workers is not None:
+            feed_kw["feed_workers"] = args.feed_workers
+        if args.wire is not None:
+            feed_kw["wire"] = args.wire
         if backends_explicit and backends != ["shard"]:
             # an explicit backend choice other than exactly 'shard' is
             # silently a no-op here — say so (mirrors the --window notice)
@@ -443,6 +462,13 @@ def main(argv: list[str] | None = None) -> int:
             )
         if backends == ["shard"]:
             import jax as _jax
+
+            if feed_kw:
+                # the sharded replay has its own per-device slice feed;
+                # the parallel pool + wire knobs only steer the
+                # single-device streamed pipeline (mirrors --window)
+                print("pluss: --feed-workers/--wire have no effect on "
+                      "the sharded replay", file=sys.stderr)
 
             if args.fmt == "u64" and _jax.process_count() > 1:
                 # multi-process: shard_replay_file's single-host compactor
@@ -496,7 +522,8 @@ def main(argv: list[str] | None = None) -> int:
                       file=sys.stderr)
             rep = replay_file_resilient(args.file, args.fmt, cls=cfg.cls,
                                         window=win, checkpoint_path=ckpt,
-                                        resume=args.resume, **bw_kw)
+                                        resume=args.resume, **bw_kw,
+                                        **feed_kw)
         dt = time.perf_counter() - t0
         if getattr(rep, "degradations", ()):
             # stderr: the stdout block format is diffed byte-for-byte

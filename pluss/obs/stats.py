@@ -5,8 +5,11 @@ self time excl. children), event counts, counter/gauge rollups
 (cumulative counters: the LAST record per name wins), and — when the
 trace-replay counters are present — the replay time breakdown the feed-
 bound diagnosis needs: reader prefetch-stall seconds, h2d staging time
-and MB/s, per-batch device time, checkpoint cost, and what fraction of
-the replay's wall clock those buckets account for.
+and MB/s, per-batch device time, checkpoint cost, what fraction of the
+replay's wall clock those buckets account for, plus the parallel-feed
+extras: concurrent wire-encode seconds across the worker pool and the
+wire-vs-device byte ratio (how much the compressed d24v wire shaved off
+the transport).
 
 ``--check`` validates the stream against the schema instead (exit 1 on
 any violation).  A torn FINAL line is tolerated with a notice — that is
@@ -231,6 +234,17 @@ def trace_breakdown(counters: dict[str, float],
     h2d_b, h2d_s = counters.get("trace.h2d_bytes"), counters.get("trace.h2d_s")
     if h2d_b and h2d_s:
         lines.append(f"  {'h2d rate':<28} {h2d_b / 1e6 / h2d_s:>9.1f} MB/s")
+    # feed-worker wire-encode runs CONCURRENTLY with the buckets above
+    # (pool threads), so it reports beside the wall accounting, not in it
+    enc = counters.get("trace.wire_encode_s")
+    if enc is not None:
+        lines.append(f"  {'wire encode (feed workers)':<28} {enc:>9.3f}s"
+                     "  (concurrent)")
+    dev_b = counters.get("trace.device_bytes")
+    if h2d_b and dev_b:
+        lines.append(
+            f"  {'wire compression':<28} {h2d_b / 1e6:>9.1f} MB wire vs "
+            f"{dev_b / 1e6:.1f} MB device ({dev_b / h2d_b:.2f}x)")
     if counters.get("trace.refs_replayed") and wall:
         lines.append(f"  {'replay rate':<28} "
                      f"{counters['trace.refs_replayed'] / wall:>9.3g} refs/s")

@@ -1,0 +1,195 @@
+"""Compressed trace wire format ``d24v``: delta + zigzag + nibble bit-pack.
+
+The streamed trace replay is feed-bound, not kernel-bound (BENCH_r05:
+the segmented device kernel holds ~6.8e7 refs/s resident while the
+end-to-end feed delivers 1.8e6 refs/s behind a 24-33 MB/s h2d pipe), so
+every byte shaved off the wire is a direct end-to-end speedup.  The
+existing packs (:func:`pluss.trace._pack_ids`) are *fixed-width* — 2/3/4
+bytes per ref decided by the id-table size alone.  ``d24v`` is
+*content-adaptive*:
+
+1. split a batch of dense int32 line ids into :data:`BLOCK`-sized blocks;
+2. per block, pick the cheaper of two transforms — **delta** (consecutive
+   id differences across the whole batch, zigzag-mapped to unsigned so
+   sign costs one bit; the batch's very first delta is taken against 0)
+   or **raw** (the ids themselves; random streams defeat delta coding,
+   and raw caps the cost at the plain pack's width).  Raw blocks reset
+   the delta chain, so the decoder recovers cross-block carries with one
+   vectorized reset-scan over the (tiny) block axis;
+3. bit-pack the block's values at the smallest *nibble-aligned* width
+   (0/4/8/../24 bits) that holds its maximum.  Nibble alignment keeps the
+   host encoder a handful of vectorized numpy passes (value→nibbles→bytes
+   by reshape) instead of a per-bit scatter, at a cost of <= 3 bits/ref
+   vs byte-exact packing.
+
+A sequential scan (deltas of 1) packs at ~0.5 B/ref — 6x under the u24
+wire; a uniformly random stream degrades to the raw width, i.e. never
+worse than the plain pack beyond the ~0.1% per-block header.
+
+The decoder is pure ``jax.numpy`` and jit-compiled by the trace layer so
+the expansion to the int32 layout the segmented kernel consumes runs ON
+DEVICE: PCIe/tunnel carries the compressed bytes, two u32 gathers + a
+funnel shift + a per-block ``cumsum`` reconstruct the ids.  Ids must be
+``< 2**24`` (the same ceiling as the u24 wire); wider tables stay on the
+plain i32 wire.
+
+Wire layout per batch:
+
+- ``wm``: ``uint8[n_blocks]`` — low 3 bits = nibbles per value (0..6),
+  bit 7 = raw mode.  Block byte lengths (``nibbles * BLOCK/2``) and
+  therefore block offsets derive from ``wm`` alone (:func:`used_bytes`).
+- ``payload``: the packed value bits, little-endian bytes, low nibble
+  first, padded by :func:`pad_len` (4-byte alignment + one u32 guard word
+  for the funnel's high fetch + eighth-octave quantization so ``jit``
+  sees a handful of payload shapes over a whole trace, not one per
+  batch).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: ids per bit-width block.  Smaller blocks adapt faster to hot/cold
+#: phase changes; bigger blocks amortize the 1-byte header and the
+#: per-block width gather.  1024 keeps the header under 0.1% of even a
+#: fully compressed (4-bit) payload.
+BLOCK = 1024
+
+#: wm mode bit: block stores raw ids, not zigzag deltas
+RAW_MODE = 0x80
+
+#: hard ceiling of the format — one nibble-width field (0..6 nibbles)
+#: must hold any value, so ids (and zigzag deltas the encoder chooses to
+#: keep) top out at 24 bits, exactly the u24 wire's ceiling
+MAX_ID = (1 << 24) - 1
+
+
+def pad_len(nbytes: int) -> int:
+    """Padded payload length: 4-aligned + one u32 guard word, then
+    quantized to an eighth of the nearest lower power of two so a whole
+    trace produces a handful of distinct payload shapes (each shape is
+    one jit retrace of the decode kernel) instead of one per batch, while
+    wasting <= ~12.5% of the wire on padding."""
+    base = -(-(nbytes + 4) // 4) * 4
+    if base <= 4096:
+        q = 64
+    else:
+        q = max(64, (1 << (int(base).bit_length() - 1)) // 8)
+    return -(-base // q) * q
+
+
+def used_bytes(wm: np.ndarray) -> int:
+    """Real payload bytes of an encoded batch (before :func:`pad_len`
+    padding), derived from the width map alone."""
+    w = np.asarray(wm, np.int64)
+    return int(((w & 0x7) * (BLOCK // 2)).sum())
+
+
+def _bit_length(m: np.ndarray) -> np.ndarray:
+    """Vectorized bit_length of non-negative ints < 2**53 (frexp's
+    exponent IS the bit length, exactly, for anything float64 holds)."""
+    return np.frexp(m.astype(np.float64))[1].astype(np.int64)
+
+
+def encode_d24v(ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Encode one batch of dense int32 line ids.  Returns
+    ``(payload uint8[pad_len(P)], wm uint8[n_blocks])``.
+
+    Raises on ids outside ``[0, 2**24)`` — callers (``pluss.trace``)
+    route wider tables to the plain i32 wire instead.
+    """
+    ids = np.ascontiguousarray(ids, np.int32)
+    n = ids.shape[0]
+    if n == 0:
+        raise ValueError("cannot d24v-encode an empty batch")
+    nb = -(-n // BLOCK)
+    if n < nb * BLOCK:
+        # pad with the last id: delta 0, free under either block mode
+        ids = np.concatenate(
+            [ids, np.full(nb * BLOCK - n, ids[-1], np.int32)])
+    blk = ids.reshape(nb, BLOCK)
+    if int(blk.min()) < 0 or int(blk.max()) > MAX_ID:
+        raise ValueError(
+            f"d24v wire holds ids in [0, 2**24); got "
+            f"[{int(blk.min())}, {int(blk.max())}]")
+    # GLOBAL diffs (first vs 0): a block head costs bit_length(|step|),
+    # not bit_length(id) — a sequential scan high in a big table still
+    # packs at ~half a byte per ref
+    d = np.diff(ids, prepend=np.int32(0)).reshape(nb, BLOCK)
+    z = ((d << 1) ^ (d >> 31)).view(np.uint32)       # zigzag, unsigned
+    raw = blk.view(np.uint32)
+    k_delta = (_bit_length(z.max(axis=1)) + 3) // 4
+    k_raw = (_bit_length(raw.max(axis=1)) + 3) // 4
+    # raw wins ties: no cumsum on decode, and it caps k at 6 nibbles
+    # (a 24-bit table's deltas can need 25 bits; its raw ids never do)
+    mode_raw = k_raw <= k_delta
+    k = np.where(mode_raw, k_raw, k_delta)
+    wm = (k | np.where(mode_raw, RAW_MODE, 0)).astype(np.uint8)
+    vals = np.where(mode_raw[:, None], raw, z)
+    blk_bytes = k * (BLOCK // 2)
+    starts = np.concatenate([[0], np.cumsum(blk_bytes)[:-1]])
+    payload = np.zeros(pad_len(int(blk_bytes.sum())), np.uint8)
+    for kk in range(1, 7):
+        sel = np.nonzero(k == kk)[0]
+        if not sel.size:
+            continue
+        v = vals[sel]                                    # [m, BLOCK] u32
+        sh = np.arange(kk, dtype=np.uint32) * 4
+        nib = ((v[:, :, None] >> sh[None, None, :]) & 0xF).astype(np.uint8)
+        nib = nib.reshape(sel.size, BLOCK * kk)          # low nibble first
+        byts = nib[:, 0::2] | (nib[:, 1::2] << 4)
+        idx = starts[sel][:, None] + np.arange(BLOCK * kk // 2)[None, :]
+        payload[idx.reshape(-1)] = byts.reshape(-1)
+    return payload, wm
+
+
+def decode_d24v(payload, wm):
+    """Device-side decode: ``(payload u8, wm u8) -> int32[n_blocks*BLOCK]``.
+
+    Pure ``jax.numpy`` — the trace layer jits it once per payload shape
+    (bounded by :func:`pad_len`'s quantization).  Two u32 gathers + a
+    funnel shift extract each value's bit window; delta blocks finish
+    with one per-block ``cumsum`` plus a vectorized reset-scan over the
+    block axis that carries the running id across block boundaries (raw
+    blocks reset the chain; int32 wraparound in the block-sum prefix is
+    benign because only differences of prefixes — true ids, which fit —
+    are ever consumed).  Trailing ids past the encoder's real length
+    decode to the padding value — callers slice to the batch length.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k = (wm & 0x7).astype(jnp.int32)
+    mode_raw = (wm & RAW_MODE) != 0
+    blk_bytes = k * (BLOCK // 2)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(blk_bytes)[:-1]])
+    b4 = payload.reshape(-1, 4).astype(jnp.uint32)
+    words = b4[:, 0] | (b4[:, 1] << 8) | (b4[:, 2] << 16) | (b4[:, 3] << 24)
+    r = jnp.arange(BLOCK, dtype=jnp.int32)
+    bit = starts[:, None] * 8 + r[None, :] * (k[:, None] * 4)  # [nb, BLOCK]
+    word = bit >> 5
+    sh = (bit & 31).astype(jnp.uint32)
+    lo = words[word]
+    hi = words[jnp.minimum(word + 1, words.shape[0] - 1)]
+    v = (lo >> sh) | jnp.where(sh == 0, jnp.uint32(0),
+                               hi << (jnp.uint32(32) - sh))
+    v = v & ((jnp.uint32(1) << (k[:, None] * 4).astype(jnp.uint32)) - 1)
+    z = v.astype(jnp.int32)
+    d = (z >> 1) ^ -(z & 1)                      # zigzag inverse
+    csum = jnp.cumsum(d, axis=1, dtype=jnp.int32)      # block-local prefix
+    # cross-block carry: base of block b = last id of block b-1.  Raw
+    # blocks know their last id absolutely; a run of delta blocks adds
+    # its block sums (csum[:, -1]) onto the nearest raw last (or 0 when
+    # the chain starts at the batch head).
+    nb = k.shape[0]
+    idx = jnp.arange(nb, dtype=jnp.int32)
+    last_raw = jax.lax.cummax(jnp.where(mode_raw, idx, -1))
+    s = jnp.where(mode_raw, 0, csum[:, -1])
+    p = jnp.cumsum(s, dtype=jnp.int32)           # may wrap; diffs are exact
+    lr = jnp.maximum(last_raw, 0)
+    c_raw = jnp.where(last_raw >= 0, v[lr, -1].astype(jnp.int32), 0)
+    p_raw = jnp.where(last_raw >= 0, p[lr], 0)
+    c = jnp.where(mode_raw, z[:, -1], c_raw + (p - p_raw))  # last id of b
+    base = jnp.concatenate([jnp.zeros((1,), jnp.int32), c[:-1]])
+    return jnp.where(mode_raw[:, None], z, base[:, None] + csum).reshape(-1)

@@ -57,8 +57,13 @@ LADDER: tuple[str, ...] = ("shrink_window", "raise_n_windows",
 SHARD_LADDER: tuple[str, ...] = ("shrink_window", "single_device",
                                  "cpu_fallback")
 
-#: ladder of trace replay (no thread dimension to slice)
-TRACE_LADDER: tuple[str, ...] = ("shrink_window", "cpu_fallback")
+#: ladder of trace replay (no thread dimension to slice): first drop the
+#: parallel feed pool back to the single reader (fewer in-flight
+#: host/device buffers, the round-6 proven path; checkpoint-less runs
+#: also shed the compressed wire for the plain pack), then shrink the
+#: window, then leave the accelerator
+TRACE_LADDER: tuple[str, ...] = ("serial_feed", "shrink_window",
+                                 "cpu_fallback")
 
 
 @dataclasses.dataclass
@@ -232,6 +237,16 @@ def replay_file_resilient(path: str, fmt: str = "u64", *,
     checkpointed variant when ``checkpoint_path``/``resume`` are passed
     through ``kw``).  Stamps ``degradations`` on the ReplayResult."""
     retry = retry or Retry()
+    ckpt = bool(kw.get("checkpoint_path"))
+    if ckpt and kw.get("wire") in (None, "auto"):
+        # the wire joins the checkpoint identity: pin the auto-resolution
+        # ONCE (explicit `auto` included) so a ladder rung — or a
+        # cpu_fallback backend flip re-aiming `auto` — can never
+        # re-resolve it mid-run and silently discard the durable prefix
+        # as a "different run"
+        from pluss import trace
+
+        kw = {**kw, "wire": trace._resolve_wire(kw.get("wire"))}
 
     def make_attempt(state: dict):
         from pluss import trace
@@ -239,12 +254,26 @@ def replay_file_resilient(path: str, fmt: str = "u64", *,
         kw2 = dict(kw)
         if "window" in state:
             kw2["window"] = state["window"]
+        if "feed_workers" in state:
+            kw2["feed_workers"] = state["feed_workers"]
+        if "wire" in state:
+            kw2["wire"] = state["wire"]
         return trace.replay_file(path, fmt, **kw2)
 
     def apply_rung(state: dict, rung: str) -> None:
         from pluss import trace
 
-        if rung == "shrink_window":
+        if rung == "serial_feed":
+            # back to the single reader thread: sheds the pool's
+            # in-flight batches before touching the window size.  The
+            # fixed-width pack (fewer device-side decode buffers) is
+            # also shed — but only on checkpoint-less runs: the wire is
+            # part of the checkpoint identity, and a degraded retry must
+            # never forfeit hours of durable prefix to drop a decode
+            state["feed_workers"] = 1
+            if not ckpt:
+                state["wire"] = "pack"
+        elif rung == "shrink_window":
             cur = state.get("window", kw.get("window") or trace.TRACE_WINDOW)
             state["window"] = max(cur // 4, 1 << 14)
         elif rung == "cpu_fallback":

@@ -35,6 +35,7 @@ bypasses the CRI model entirely.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import os
@@ -83,6 +84,14 @@ class ReplayResult:
     #: degradation-ladder rungs taken (pluss.resilience) — empty for a
     #: clean first-attempt replay
     degradations: tuple = ()
+    #: effective streamed-feed configuration of the run that produced
+    #: this result (:func:`replay_file` stamps both; consumers that
+    #: record the measurement setup — bench — read them off the result
+    #: instead of re-resolving process defaults, which a degradation
+    #: rung or backend flip may have left behind).  Empty/0 from
+    #: constructors with no streamed feed.
+    wire: str = ""
+    feed_workers: int = 0
 
     def histogram(self) -> dict:
         out = {-1: float(self.hist[0])}
@@ -135,6 +144,59 @@ def _segmented_default() -> bool:
     if env is not None:
         return env.lower() not in ("0", "false", "off", "")
     return jax.default_backend() != "cpu"
+
+
+#: streamed-feed wire selector (``--wire`` / ``PLUSS_WIRE`` / the
+#: ``wire`` kwarg): ``pack`` = the fixed-width u16/u24/i32 packs,
+#: ``d24v`` = the delta+zigzag+nibble bit-packed compressed wire
+#: (:mod:`pluss.ops.wirecodec`, decoded on device), ``auto`` = d24v on
+#: accelerators (the PCIe/tunnel bytes ARE the streamed bottleneck —
+#: BENCH_r04/r05 ``upload_mb_s``), plain pack on the CPU backend (no
+#: transport to compress for, and the decode gathers would only add
+#: host work).  Histograms are wire-invariant by construction; the
+#: property suite pins it.
+WIRE_CHOICES = ("auto", "pack", "d24v")
+
+
+def _resolve_wire(wire: str | None) -> str:
+    """The effective wire format.  Explicit bad values fail loudly; a
+    malformed PLUSS_WIRE warns and falls back (envknob policy)."""
+    if wire is None:
+        from pluss.utils.envknob import env_choice
+
+        wire = env_choice("PLUSS_WIRE", "auto", WIRE_CHOICES)
+    if wire not in WIRE_CHOICES:
+        raise ValueError(
+            f"unknown wire format {wire!r} (choices: "
+            f"{', '.join(WIRE_CHOICES)})")
+    if wire == "auto":
+        return "d24v" if jax.default_backend() != "cpu" else "pack"
+    return wire
+
+
+def _default_feed_workers() -> int:
+    """Backend-aware default for the reader/packer pool: on the CPU
+    backend the replay kernel computes on the same cores, so extra feed
+    threads only oversubscribe the box the tier-1 suites run on —
+    default 1 (the single-reader pipeline).  On accelerators the host
+    cores idle while the device computes; use most of them."""
+    if jax.default_backend() == "cpu":
+        return 1
+    ncpu = os.cpu_count() or 1
+    return max(2, min(8, ncpu - 1))
+
+
+def _resolve_feed_workers(feed_workers: int | None) -> int:
+    """Validated reader/packer worker count.  An explicit 0/-1 must fail
+    loudly (a zero-worker pool would deliver nothing and hang the feed);
+    a malformed PLUSS_FEED_WORKERS warns and falls back to the backend
+    default, same as every other env knob."""
+    if feed_workers is None:
+        return _env_int("PLUSS_FEED_WORKERS", _default_feed_workers())
+    fw = int(feed_workers)
+    if fw < 1:
+        raise ValueError(f"feed_workers must be >= 1, got {fw}")
+    return fw
 
 
 class _threaded:
@@ -203,6 +265,152 @@ class _threaded:
         return False
 
 
+class _FeedPool:
+    """Ordered N-worker feed pipeline: read (parallel) → compact
+    (stream-order turnstile) → wire-encode (parallel) → strict in-order
+    delivery.
+
+    The single reader thread (:class:`_threaded`) tops out at the
+    sequential read+compact+pack rate — 23-33 MB/s recorded
+    (BENCH_r04/r05 ``upload_mb_s``) against a device kernel holding
+    ~6.8e7 refs/s resident, the 37x streamed-vs-resident gap.  Batch
+    extents are independent on disk and the pack/encode is
+    embarrassingly parallel per extent, so N workers overlap them; only
+    the compactor stage is order-dependent (cluster discovery mutates
+    shared state and is part of the checkpoint identity), so it runs
+    under a turnstile admitting batches in exact stream order.  numpy
+    reads and packs release the GIL, so the overlap is real under
+    CPython.
+
+    Delivery is strictly in batch order, and a worker exception is
+    delivered at ITS batch index — after every earlier batch — so fault
+    injection and checkpoint/resume keep the same prefix semantics as
+    the single reader.  ``claim_fn(b)`` runs under the claim lock in
+    exact batch order: the chaos-injection site lives there, so
+    ``trace_loss@n`` keeps firing on the n-th *stream* batch, not on
+    whichever worker races to the site first.  In-flight batches
+    (claimed but not yet consumed) are bounded by ``depth + workers``.
+    """
+
+    def __init__(self, b0: int, end: int, claim_fn, read_fn, compact_fn,
+                 encode_fn, workers: int, depth: int):
+        import threading
+
+        self._end = end
+        self._claim_fn, self._read_fn = claim_fn, read_fn
+        self._compact_fn, self._encode_fn = compact_fn, encode_fn
+        self.workers = workers
+        self._budget = depth + workers
+        self._cv = threading.Condition()
+        self._next_claim = b0
+        self._turn = b0
+        self._next_out = b0
+        self._done: dict[int, object] = {}
+        self._stop = False
+        self.busy = 0          # workers mid-batch (telemetry gauge)
+        self.encode_s = 0.0    # summed wire-encode seconds across workers
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True,
+                             name=f"pluss-feed-{i}")
+            for i in range(workers)]
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=60)
+        return False
+
+    def qsize(self) -> int:
+        """Finished batches awaiting in-order delivery (the occupancy
+        gauge: persistently zero means the feed is the bottleneck)."""
+        return len(self._done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        with self._cv:
+            if self._next_out >= self._end:
+                raise StopIteration
+            while self._next_out not in self._done:
+                if not any(t.is_alive() for t in self._threads):
+                    raise RuntimeError(
+                        f"feed pool lost batch {self._next_out}: all "
+                        "workers exited without delivering it")
+                self._cv.wait(0.5)
+            item = self._done.pop(self._next_out)
+            self._next_out += 1
+            self._cv.notify_all()
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def _run(self):
+        import time as _time
+
+        while True:
+            err = None
+            with self._cv:
+                while (not self._stop and self._next_claim < self._end
+                       and self._next_claim - self._next_out
+                       >= self._budget):
+                    self._cv.wait(0.5)
+                if self._stop or self._next_claim >= self._end:
+                    return
+                b = self._next_claim
+                self._next_claim += 1
+                self.busy += 1
+                try:
+                    self._claim_fn(b)   # ordered under the lock
+                except BaseException as e:
+                    err = e
+            raw = mid = item = None
+            if err is None:
+                try:
+                    raw = self._read_fn(b)
+                except BaseException as e:
+                    err = e
+            # compact turnstile: strictly in stream order.  A FAILED
+            # batch still takes and releases its turn — later batches
+            # (doomed to be discarded once the error is delivered at b)
+            # must not deadlock behind it.
+            with self._cv:
+                while not self._stop and self._turn != b:
+                    self._cv.wait(0.5)
+                if self._stop:
+                    self.busy -= 1
+                    return
+            if err is None:
+                try:
+                    mid = self._compact_fn(b, raw)
+                except BaseException as e:
+                    err = e
+            with self._cv:
+                self._turn = b + 1
+                self._cv.notify_all()
+            enc = 0.0
+            if err is None:
+                t0 = _time.perf_counter()
+                try:
+                    item = self._encode_fn(b, mid)
+                except BaseException as e:
+                    err = e
+                enc = _time.perf_counter() - t0
+            with self._cv:
+                self.busy -= 1
+                if err is None:
+                    self.encode_s += enc
+                self._done[b] = err if err is not None else item
+                self._cv.notify_all()
+
+
 #: packed-trace wire-format version, stamped in pack_file's sidecar.  Bump
 #: whenever the on-disk id encoding (u16/u24/i32 packing, byte order, the
 #: compaction semantics feeding it) changes meaning — consumers that cache
@@ -237,12 +445,96 @@ def _pack16(ids: np.ndarray) -> np.ndarray:
 
 
 def _pack_ids(ids: np.ndarray, n_lines: int) -> np.ndarray:
-    """Tightest wire format the line-table size allows."""
+    """Tightest FIXED-WIDTH wire format the line-table size allows (the
+    ``pack`` wire; :func:`_encode_wire` layers the content-adaptive
+    ``d24v`` compression on top)."""
     if n_lines <= 1 << 16:
         return _pack16(ids)
     if n_lines < 1 << 24:
         return _pack24(ids)
     return ids
+
+
+#: one d24v-encoded batch as it rides the feed queue (host numpy arrays
+#: until the staging step device_puts them as a pytree)
+_WireD24V = collections.namedtuple("_WireD24V", ("payload", "wm"))
+
+#: batches above this many ids stay on the plain pack even under
+#: ``wire=d24v``: the decode kernel's bit-offset math is int32
+_D24V_MAX_BATCH = 1 << 26
+
+
+def _encode_wire(ids: np.ndarray, n_lines: int, wirefmt: str):
+    """One padded batch slice -> what ships over the h2d transport: a
+    :class:`_WireD24V` under the compressed wire (tables under 2^24
+    lines), else the fixed-width pack."""
+    if wirefmt == "d24v" and n_lines < 1 << 24 \
+            and ids.shape[0] <= _D24V_MAX_BATCH:
+        from pluss.ops import wirecodec
+
+        return _WireD24V(*wirecodec.encode_d24v(ids))
+    return _pack_ids(ids, n_lines)
+
+
+def _extent_reader(path: str, batch: int, n: int):
+    """Raw u64 extent reader (shared by the replay and pack feeds).
+    Extents are independent on disk, so each read opens its own handle
+    (an OS open+seek costs nothing next to a 100+ MB read) — this is
+    what lets N feed workers read concurrently.  Never reads past ``n``:
+    a limit_refs prefix must not compact (or grow the device table with)
+    addresses it will mask out anyway."""
+    def read_raw(b):
+        with open(path, "rb") as f:
+            f.seek(b * batch * 8)
+            return np.fromfile(f, dtype="<u8",
+                               count=min(batch, n - b * batch))
+    return read_raw
+
+
+def _compact_stage(comp, shift: int, precompacted: bool, snapshot: bool):
+    """Raw addresses -> ``(dense ids, table size, compactor snapshot)``
+    (STATEFUL: feed pools run this under the stream-order turnstile).
+    The snapshot rides WITH the batch so a checkpointing/journaling
+    consumer records state consistent with what it has actually
+    consumed, even while producers run ahead; ``snapshot=False`` skips
+    it for consumers that never persist (it costs a table copy)."""
+    def compact_batch(b, raw):
+        ids = comp.map_raw(raw, 0 if precompacted else shift)
+        if ids is None:
+            lines = raw.astype(np.int64) if precompacted \
+                else raw.astype(np.int64) >> shift
+            ids = comp.map(lines)
+        return ids, comp.next_free, comp.snapshot() if snapshot else None
+    return compact_batch
+
+
+@functools.lru_cache(maxsize=4)
+def _decode_fn(backend: str):
+    """Jitted d24v -> int32 expansion (pluss.ops.wirecodec.decode_d24v).
+    A SEPARATE executable from the replay kernel, so the handful of
+    payload shapes (wirecodec.pad_len quantizes them) retrace only this
+    small decode — never the batch sort."""
+    from pluss.ops import wirecodec
+
+    return jax.jit(wirecodec.decode_d24v)
+
+
+@functools.lru_cache(maxsize=4)
+def _stage_decode_fn(backend: str):
+    """Jitted d24v record -> the resident u24 byte layout: the
+    PCIe/tunnel carries the compressed record, HBM holds the same
+    3 B/ref layout :func:`replay_staged` already consumes."""
+    from pluss.ops import wirecodec
+
+    def f(payload, wm, count, batch):
+        ids = wirecodec.decode_d24v(payload, wm)
+        ids = jnp.zeros((batch,), jnp.int32).at[:count].set(ids[:count])
+        u = ids.astype(jnp.uint32)
+        return jnp.stack(
+            [u & 0xFF, (u >> 8) & 0xFF, (u >> 16) & 0xFF],
+            axis=-1).astype(jnp.uint8)
+
+    return jax.jit(f, static_argnums=(2, 3))
 
 
 def _widen_ids(line_w):
@@ -545,13 +837,15 @@ def _trace_fingerprint(path: str) -> str:
 
 def _ckpt_save(path: str, b_next: int, n: int, window: int, cls: int,
                precompacted: bool, fp: str, last_pos, hist,
-               comp_snap: dict, batch_windows: int) -> None:
+               comp_snap: dict, batch_windows: int, wirefmt: str) -> None:
     """Atomic replay checkpoint: everything a resumed run needs to continue
     bit-identically (device carries + compactor id table + position), plus
-    the FULL run identity — (n, window, cls, precompacted, batch_windows)
-    all change the compaction/batching semantics and ``fp`` binds the
-    source file's content, so a mismatch on any of them must start fresh,
-    never splice.
+    the FULL run identity — (n, window, cls, precompacted, batch_windows,
+    wirefmt) all change the compaction/batching/feed semantics and ``fp``
+    binds the source file's content, so a mismatch on any of them must
+    start fresh, never splice.  The wire format is histogram-invariant,
+    but it joins the identity anyway: a resume must never silently blend
+    two encodings of one stream (the same rule the pack journal applies).
 
     Only the LIVE prefix of ``last_pos`` (the compactor's ``next_free``
     slots) is d2h-fetched and written — every slot past it is still the
@@ -574,12 +868,14 @@ def _ckpt_save(path: str, b_next: int, n: int, window: int, cls: int,
              bw=np.int64(batch_windows),
              precompacted=np.int64(bool(precompacted)),
              fp=np.frombuffer(fp.encode(), np.uint8),
+             wirefmt=np.frombuffer(wirefmt.encode(), np.uint8),
              comp=np.frombuffer(json.dumps(comp_snap).encode(), np.uint8))
     os.replace(tmp, path)
 
 
 def _ckpt_load(path: str, n: int, window: int, cls: int,
-               precompacted: bool, fp: str, batch_windows: int):
+               precompacted: bool, fp: str, batch_windows: int,
+               wirefmt: str):
     """(b_next, last_pos, hist, comp) from a checkpoint, or None when the
     checkpoint is absent or describes a different run identity.  The
     ``last_pos`` carry is reconstructed at full capacity from the saved
@@ -591,18 +887,19 @@ def _ckpt_load(path: str, n: int, window: int, cls: int,
         return None
     try:
         with np.load(path) as z:
-            if "bw" not in z.files or "capacity" not in z.files:
+            if "bw" not in z.files or "capacity" not in z.files \
+                    or "wirefmt" not in z.files:
                 print(f"trace: checkpoint {path} is from an older layout; "
                       "starting fresh", file=sys.stderr)
                 return None
             ident = (int(z["n"]), int(z["window"]), int(z["cls"]),
                      int(z["precompacted"]), bytes(z["fp"]).decode(),
-                     int(z["bw"]))
+                     int(z["bw"]), bytes(z["wirefmt"]).decode())
             if ident != (n, window, cls, int(bool(precompacted)), fp,
-                         batch_windows):
+                         batch_windows, wirefmt):
                 print(f"trace: checkpoint {path} is for a different run "
-                      f"(n, window, cls, precompacted, file, bw)={ident}; "
-                      "starting fresh", file=sys.stderr)
+                      f"(n, window, cls, precompacted, file, bw, "
+                      f"wire)={ident}; starting fresh", file=sys.stderr)
                 return None
             comp = _Compactor.restore(
                 json.loads(bytes(z["comp"]).decode()))
@@ -634,7 +931,10 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                 resume: bool = False,
                 batch_windows: int | None = None,
                 queue_depth: int | None = None,
-                segmented: bool | None = None) -> ReplayResult:
+                segmented: bool | None = None,
+                feed_workers: int | None = None,
+                wire: str | None = None,
+                stage_depth: int | None = None) -> ReplayResult:
     """Replay a trace FILE in bounded host memory (BASELINE config 5 scale).
 
     Unlike ``replay(load_trace(path))``, which slurps the whole file, this
@@ -646,20 +946,34 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     discovers the working set (each growth retraces the jitted step —
     O(log) growths).
 
-    The feed is DOUBLE-BUFFERED: batch ``b+1``'s ``device_put`` is
-    dispatched while batch ``b``'s kernel runs, so the h2d transfer and
-    the device compute overlap instead of paying upload + scan serially
-    (the whole point of the segmented kernel — one dispatch per batch —
-    is that the pipe has exactly one compute stage to hide behind).
+    The feed is a PARALLEL, DEPTH-CONFIGURABLE pipeline: ``feed_workers``
+    reader/packer threads split the file into batch-aligned extents and
+    read + wire-encode them concurrently (the compactor stage runs under
+    a stream-order turnstile, :class:`_FeedPool`); the consumer keeps up
+    to ``stage_depth`` batches' ``device_put`` (and, under the compressed
+    wire, their device-side decode) dispatched ahead of the kernel, so
+    host encode of batch ``b+2`` and upload of ``b+1`` both overlap
+    device compute of ``b``.
 
     ``batch_windows``: windows per device batch (default
     :data:`WINDOWS_PER_BATCH`); part of the checkpoint identity.
-    ``queue_depth``: reader-thread queue bound (default
-    ``PLUSS_TRACE_QUEUE_DEPTH`` env or 2) — deeper queues absorb burstier
-    disk/compaction latency at the cost of more in-flight host batches.
+    ``queue_depth``: feed queue bound (default ``PLUSS_TRACE_QUEUE_DEPTH``
+    env or 2) — deeper queues absorb burstier disk/compaction latency at
+    the cost of more in-flight host batches.
     ``segmented``: kernel selector for A/B verification (default:
     backend-aware — segmented on accelerators, the legacy per-window scan
     on CPU; ``PLUSS_TRACE_SEGMENTED`` overrides either way).
+    ``feed_workers``: reader/packer pool width (default
+    ``PLUSS_FEED_WORKERS`` env, else backend-aware — 1 on the CPU
+    backend, most host cores on accelerators); 1 keeps the single
+    reader thread.
+    ``wire``: h2d encoding — ``pack`` (fixed-width u16/u24/i32),
+    ``d24v`` (delta+zigzag+nibble bit-pack, decoded on device), or
+    ``auto``/None (``PLUSS_WIRE`` env, else d24v on accelerators, pack
+    on CPU).  Histogram-invariant; part of the checkpoint identity so
+    resumes never splice across encodings.
+    ``stage_depth``: staged-ahead device batches (default
+    ``PLUSS_TRACE_STAGE_DEPTH`` env or 2 — the classic double buffer).
 
     ``deadline_s``: optional wall clock cap — the batch loop stops cleanly
     after the batch in flight when exceeded, returning the refs actually
@@ -700,11 +1014,22 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
         )
     fn = _replay_fn(window, pos_dtype, segmented)
     pdt = np.dtype(pos_dtype)
+    wirefmt = _resolve_wire(wire)
+    workers = _resolve_feed_workers(feed_workers)
+    if stage_depth is None:
+        sd = _env_int("PLUSS_TRACE_STAGE_DEPTH", 2)
+    else:
+        sd = int(stage_depth)
+        if sd < 1:
+            # depth 0 would stage nothing and replay zero batches while
+            # claiming success — same failure class as batch_windows<1
+            raise ValueError(f"stage_depth must be >= 1, got {sd}")
 
     b0 = 0
     comp0 = _Compactor()
     fp = _trace_fingerprint(path) if checkpoint_path else ""
-    ck = _ckpt_load(checkpoint_path, n, window, cls, precompacted, fp, bw) \
+    ck = _ckpt_load(checkpoint_path, n, window, cls, precompacted, fp, bw,
+                    wirefmt) \
         if resume and checkpoint_path else None
     if ck is not None:
         b0, ck_last_pos, ck_hist, comp0 = ck
@@ -715,47 +1040,46 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
               file=sys.stderr)
     if b0 >= n_batches:   # checkpoint already covers the whole stream
         return ReplayResult(np.asarray(ck_hist, np.int64), n,
-                            comp0.next_free)
+                            comp0.next_free, wire=wirefmt,
+                            feed_workers=workers)
+
+    comp = comp0
+    enc_acc = [0.0]   # wire-encode seconds of the single-reader paths
+    read_raw = _extent_reader(path, batch, n)
+    compact_batch = _compact_stage(comp, shift, precompacted,
+                                   snapshot=bool(checkpoint_path))
+
+    def encode_batch(b, mid):
+        """Pad to the fixed batch shape and wire-encode (pure per-extent
+        work — embarrassingly parallel across pool workers)."""
+        ids, n_lines_b, snap_b = mid
+        pad = batch - len(ids)
+        if pad:
+            ids = np.concatenate([ids, np.zeros(pad, np.int32)])
+        return _encode_wire(ids, n_lines_b, wirefmt), n_lines_b, snap_b
 
     def batches():
-        """(padded ids, table size, compactor snapshot) per disk batch, in
-        stream order (the compactor is stateful).  Ids ship 24-bit packed
-        (u8 [n, 3]) while the table fits — the h2d feed, not device
-        compute, bounds this path end-to-end (see _pack24).  The snapshot
-        rides WITH the batch so the checkpointing consumer records state
-        consistent with what it has actually dispatched, even while the
-        producer thread runs ahead."""
+        """Single-reader feed: the same three stages, run inline in
+        stream order (``feed_workers=1`` behind the bounded queue, or
+        ``pipeline=False`` fully inline for debugging/A-B)."""
         from pluss.resilience import faults
 
-        comp = comp0
-        with open(path, "rb") as f:
-            f.seek(b0 * batch * 8)
-            for b in range(b0, n_batches):
-                faults.check("trace.read_batch")  # chaos injection site
-                # never read past n: a limit_refs prefix must not compact
-                # (or grow the device table with) addresses it will mask
-                # out anyway
-                raw = np.fromfile(f, dtype="<u8",
-                                  count=min(batch, n - b * batch))
-                ids = comp.map_raw(raw, 0 if precompacted else shift)
-                if ids is None:
-                    lines = raw.astype(np.int64) if precompacted \
-                        else raw.astype(np.int64) >> shift
-                    ids = comp.map(lines)
-                pad = batch - len(ids)
-                if pad:
-                    ids = np.concatenate([ids, np.zeros(pad, np.int32)])
-                snap = comp.snapshot() if checkpoint_path else None
-                yield _pack_ids(ids, comp.next_free), comp.next_free, snap
+        for b in range(b0, n_batches):
+            faults.check("trace.read_batch")  # chaos injection site
+            mid = compact_batch(b, read_raw(b))
+            t0 = _time.perf_counter()
+            item = encode_batch(b, mid)
+            enc_acc[0] += _time.perf_counter() - t0
+            yield item
 
-    # pipelined host side: a reader thread streams disk batches through the
-    # (stateful, hence single-threaded) compactor while the main thread
-    # stages/dispatches to the device — the disk+compaction+packing latency
-    # hides behind the previous batch's transfer and scan.  The queue bound
-    # keeps host memory at ~queue_depth in-flight batches; numpy IO and the
-    # native compactor pass release the GIL, so the overlap is real even on
-    # one core.  ``pipeline=False`` runs the same generator inline
-    # (debugging / A-B measurement).
+    # pipelined host side: feed_workers reader/packer threads stream disk
+    # batches through the (stateful, hence turnstiled) compactor while
+    # the main thread stages/dispatches to the device — the
+    # disk+compaction+encode latency hides behind earlier batches'
+    # transfer and kernel.  The queue bound keeps host memory at a few
+    # in-flight batches; numpy IO, packing, and the native compactor
+    # pass release the GIL, so the overlap is real even on one core.
+    # ``pipeline=False`` runs the same stages inline (debugging / A-B).
     import contextlib
 
     qd = queue_depth if queue_depth is not None else \
@@ -764,8 +1088,17 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
         # queue.Queue(maxsize=0) means UNBOUNDED — the reader would buffer
         # the whole trace and break the bounded-host-memory contract
         raise ValueError(f"queue_depth must be >= 1, got {qd}")
-    src = _threaded(batches, depth=qd) if pipeline else \
-        contextlib.nullcontext(batches())
+    if not pipeline:
+        src = contextlib.nullcontext(batches())
+    elif workers > 1:
+        from pluss.resilience import faults
+
+        src = _FeedPool(b0, n_batches,
+                        lambda b: faults.check("trace.read_batch"),
+                        read_raw, compact_batch, encode_batch,
+                        workers, qd)
+    else:
+        src = _threaded(batches, depth=qd)
     import time as _time
 
     t0 = _time.perf_counter()
@@ -791,44 +1124,82 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     # multi-M-ref batch); recorded only when telemetry is enabled.
     st = {"prefetch_stall_s": 0.0, "h2d_s": 0.0, "device_s": 0.0,
           "ckpt_save_s": 0.0, "grow_s": 0.0}
-    st_n = {"h2d_bytes": 0, "batches": 0, "ckpt_saves": 0, "growths": 0}
+    st_n = {"h2d_bytes": 0, "device_bytes": 0, "batches": 0,
+            "ckpt_saves": 0, "growths": 0}
     obs_on = obs.enabled()
+    backend = jax.default_backend()
 
     def stage(item):
-        """Start one packed batch's h2d transfer NOW.  ``device_put`` is
-        async, so calling this right after dispatching the PREVIOUS
-        batch's kernel double-buffers the feed: upload b+1 overlaps
-        compute b, and at most two batches are in flight on the device."""
+        """Start one batch's h2d transfer NOW.  ``device_put`` (and the
+        d24v device-side decode dispatch) are async, so staging right
+        after dispatching an earlier batch's kernel overlaps upload with
+        compute; the compressed wire ships its payload+width-map and
+        expands to the int32 layout on device."""
         if item is None:
             return None
-        ids, n_lines_b, snap_b = item
-        shaped = ids.reshape((bw, window) + ids.shape[1:])
-        return jax.device_put(shaped), n_lines_b, snap_b, ids.nbytes
+        w, n_lines_b, snap_b = item
+        if isinstance(w, _WireD24V):
+            nbytes = w.payload.nbytes + w.wm.nbytes
+            flat = _decode_fn(backend)(jax.device_put(w.payload),
+                                       jax.device_put(w.wm))
+            shaped = flat[:batch].reshape(bw, window)
+        else:
+            nbytes = w.nbytes
+            shaped = jax.device_put(w.reshape((bw, window) + w.shape[1:]))
+        return shaped, n_lines_b, snap_b, nbytes
 
     with obs.span("trace.replay_file", refs=n, window=window,
-                  batch_windows=bw, resume_batch=b0) as sp, \
+                  batch_windows=bw, resume_batch=b0, feed_workers=workers,
+                  wire=wirefmt) as sp, \
             xprof.session(), src as it:
         stream = iter(it)
+        from collections import deque
 
-        def fetch_next():
-            """Pull + stage the next batch, splitting time blocked on the
-            reader thread (prefetch stall: the feed is behind) from time
-            spent handing bytes to the device (h2d staging dispatch)."""
-            t1 = _time.perf_counter()
-            item = next(stream, None)
-            t2 = _time.perf_counter()
-            st["prefetch_stall_s"] += t2 - t1
-            out = stage(item)
-            st["h2d_s"] += _time.perf_counter() - t2
-            if out is not None:
+        pending: deque = deque()
+        exhausted = False
+        feed_err: BaseException | None = None
+        truncated = False
+
+        def pump():
+            """Refill the staged-ahead pipeline to ``stage_depth``
+            batches, splitting time blocked on the feed (prefetch stall:
+            the feed is behind) from time handing bytes to the device
+            (h2d staging dispatch).  Dispatch-only, so it returns while
+            the transfers and decodes run behind the kernel.
+
+            A feed/staging error is HELD, not raised: batches already
+            staged must still be processed (and checkpointed) before the
+            error surfaces, so a fault in batch b+sd never costs batch
+            b's durable point — the same guarantee the double buffer
+            gave at depth 1, kept at every depth."""
+            nonlocal exhausted, feed_err
+            while not exhausted and len(pending) < sd:
+                t1 = _time.perf_counter()
+                try:
+                    item = next(stream, None)
+                except BaseException as e:
+                    feed_err = e
+                    exhausted = True
+                    st["prefetch_stall_s"] += _time.perf_counter() - t1
+                    break
+                t2 = _time.perf_counter()
+                st["prefetch_stall_s"] += t2 - t1
+                if item is None:
+                    exhausted = True
+                    break
+                out = stage(item)
+                st["h2d_s"] += _time.perf_counter() - t2
                 st_n["h2d_bytes"] += out[3]
-            return out
+                # what the kernel consumes after widening/decode: the
+                # wire-vs-device ratio reads straight off the counters
+                st_n["device_bytes"] += batch * 4
+                pending.append(out)
 
         try:
-            nxt = fetch_next()
+            pump()
             b = b0
-            while nxt is not None:
-                ids_dev, n_lines, snap, _ = nxt
+            while pending:
+                ids_dev, n_lines, snap, _ = pending.popleft()
                 if n_lines > capacity:
                     tg = _time.perf_counter()
                     while capacity < n_lines:
@@ -849,6 +1220,8 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                 st_n["batches"] += 1
                 if obs_on and pipeline:
                     obs.gauge_set("trace.queue_occupancy", it.qsize())
+                    if isinstance(it, _FeedPool):
+                        obs.gauge_set("trace.feed_workers_busy", it.busy)
                 done = min(n, (b + 1) * batch)
                 if checkpoint_path and done < n \
                         and (b + 1 - b0) % checkpoint_every == 0:
@@ -859,7 +1232,8 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                     # durable point
                     tc = _time.perf_counter()
                     _ckpt_save(checkpoint_path, b + 1, n, window, cls,
-                               precompacted, fp, last_pos, hist, snap, bw)
+                               precompacted, fp, last_pos, hist, snap, bw,
+                               wirefmt)
                     st["ckpt_save_s"] += _time.perf_counter() - tc
                     st_n["ckpt_saves"] += 1
                 # the cheap unsynced clock runs every batch; the device
@@ -879,16 +1253,23 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                         if obs_on:
                             obs.event("trace.deadline_truncated",
                                       done=done, refs=n)
+                        truncated = True
                         break
-                # double buffering: the NEXT batch's device_put is
-                # dispatched while this batch's kernel runs (dispatch
-                # above is async; the checkpoint branch is a no-op on all
-                # but every checkpoint_every-th batch), so the h2d feed
-                # and the scan overlap instead of being paid serially.  A
-                # dropped in-flight prefetch at a deadline break is
-                # harmless — it never dispatches compute
-                nxt = fetch_next()
+                # staged-ahead pipeline: up to stage_depth batches'
+                # device_put/decode are dispatched while this batch's
+                # kernel runs (dispatch above is async; the checkpoint
+                # branch is a no-op on all but every checkpoint_every-th
+                # batch), so the h2d feed and the kernel overlap instead
+                # of being paid serially.  Staged batches dropped at a
+                # deadline break are harmless — they never dispatch
+                # compute
+                pump()
                 b += 1
+            if feed_err is not None and not truncated:
+                # every staged batch has been processed and checkpointed;
+                # NOW the held feed error surfaces (a deadline break
+                # instead discards it with the rest of the in-flight feed)
+                raise feed_err
             # the final d2h fetch is what forces every outstanding
             # dispatch to completion — that wait is device time
             td = _time.perf_counter()
@@ -903,6 +1284,12 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                     obs.counter_add(f"trace.{k}", v)
                 for k, v in st_n.items():
                     obs.counter_add(f"trace.{k}", v)
+                # host wire-encode seconds run CONCURRENTLY with the
+                # main-thread buckets above (pool workers), so this is a
+                # separate counter, not a wall bucket
+                obs.counter_add(
+                    "trace.wire_encode_s",
+                    it.encode_s if isinstance(it, _FeedPool) else enc_acc[0])
                 # only the refs THIS run replayed: a resumed run's span
                 # wall covers the tail after the checkpoint, so counting
                 # the restored prefix would inflate every rate derived
@@ -918,37 +1305,51 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
             os.unlink(checkpoint_path)
         except OSError:
             pass
-    return ReplayResult(hist_np, done, n_lines)
+    return ReplayResult(hist_np, done, n_lines, wire=wirefmt,
+                        feed_workers=workers)
 
 
 def pack_file(path: str, out_path: str, cls: int = 64,
               window: int = TRACE_WINDOW, precompacted: bool = False,
               limit_refs: int | None = None,
               resume: bool = False, _wide: bool = False,
-              batch_windows: int | None = None) -> dict:
+              batch_windows: int | None = None,
+              feed_workers: int | None = None,
+              wire: str | None = None) -> dict:
     """Compact + pack a raw u64 trace ONCE, writing the replay wire format.
 
     Streams the trace through the same incremental compactor as
-    :func:`replay_file` and writes the packed dense-id stream plus a JSON
-    sidecar (``out_path + '.json'``) with ``{n, n_lines, fmt}``.  The
-    host-side compaction of a 1e9-ref trace costs minutes on this box's
-    single core; paying it once lets :func:`replay_resident` stage
-    straight from disk on every later run.  Returns the sidecar dict.
+    :func:`replay_file` — reusing its parallel reader/packer pool
+    (``feed_workers``), so the pack runs at N-worker rate while only the
+    order-dependent compactor stage serializes — and writes the packed
+    dense-id stream plus a JSON sidecar (``out_path + '.json'``) with
+    ``{n, n_lines, fmt}``.  The host-side compaction of a 1e9-ref trace
+    costs minutes single-threaded; paying it once lets
+    :func:`replay_resident` stage straight from disk on every later run.
+    Returns the sidecar dict.
 
     Wire format: 24-bit/ref (``fmt: u24``) while the id table fits 2^24
     lines — decided by the FINAL table size, which is unknown mid-stream,
     so the 3-byte format is written optimistically and the pack RESTARTS
     in the 4-byte little-endian int32 format (``fmt: i32``) the moment
     the table overflows (real traces that blow 2^24 lines blow it early,
-    so the wasted prefix is small).  The staging/replay side widens
-    either format on device (:func:`_widen_ids`).
+    so the wasted prefix is small).  ``wire='d24v'`` writes the
+    COMPRESSED wire instead (``fmt: d24v``): per-batch records of
+    ``u32 payload_len | width map | bit-packed payload``, with the
+    record offsets in the sidecar so staging reads them in parallel and
+    the device decodes them straight into HBM (the 3 GB pack of a
+    1e9-ref trace crosses PCIe as a fraction of itself).  The on-disk
+    format never depends on the backend, so ``auto`` here means the
+    fixed-width pack.  The staging/replay side widens/decodes any format
+    on device.
 
     Progress journals to ``out_path + '.journal'`` per flushed batch (the
     output offset + the compactor's id table); ``resume=True`` after a
     crash truncates the partial ``.tmp`` to the last journaled batch
     boundary and continues — byte-identical to an uninterrupted pack, with
     zero batches recompacted before the checkpoint.  The journal records
-    the wire format, so a resumed i32 pack stays i32.
+    the wire format, so a resumed pack can never splice across formats
+    (an i32 fallback stays i32, a d24v pack stays d24v).
     """
     import json
 
@@ -960,16 +1361,31 @@ def pack_file(path: str, out_path: str, cls: int = 64,
         n = min(n, limit_refs)
     if cls & (cls - 1):
         raise ValueError(f"cache line size {cls} is not a power of two")
+    if wire is not None and wire not in WIRE_CHOICES:
+        raise ValueError(
+            f"unknown wire format {wire!r} (choices: "
+            f"{', '.join(WIRE_CHOICES)})")
+    workers = _resolve_feed_workers(feed_workers)
     shift = int(cls).bit_length() - 1
     bw = _resolve_bw(batch_windows)
     batch = bw * window
+    if wire == "d24v" and batch > _D24V_MAX_BATCH:
+        # the decode kernel's bit-offset math is int32 (same ceiling
+        # _encode_wire enforces on the streamed feed) — a pack written
+        # past it would decode GARBAGE at stage time, so fail at pack
+        # time, loudly
+        raise ValueError(
+            f"d24v records cap at {_D24V_MAX_BATCH} refs/batch "
+            f"(int32 decode offsets); batch_windows*window = {batch} — "
+            "reduce the batch or pack with wire='pack'")
     n_batches = -(-n // batch)
     comp = _Compactor()
     tmp = out_path + ".tmp"
     jpath = out_path + ".journal"
     b0 = 0
     fp = _trace_fingerprint(path)
-    fmt = "i32" if _wide else "u24"
+    fmt = "i32" if _wide else ("d24v" if wire == "d24v" else "u24")
+    offsets: list[int] = []   # d24v record offsets (sidecar, for staging)
     if resume and not _wide and os.path.exists(jpath):
         rec0 = Journal(jpath).get({"batch": 0})
         if rec0 is not None and rec0.get("fmt") == "i32":
@@ -977,7 +1393,14 @@ def pack_file(path: str, out_path: str, cls: int = 64,
             # format; resume in it instead of re-deciding from scratch
             return pack_file(path, out_path, cls, window, precompacted,
                             limit_refs, resume=True, _wide=True,
-                            batch_windows=bw)
+                            batch_windows=bw, feed_workers=workers)
+        if rec0 is not None and rec0.get("fmt") == "d24v" \
+                and wire in (None, "auto"):
+            # same continuation rule for the compressed format: a crashed
+            # d24v pack resumed without re-passing wire='d24v' must stay
+            # d24v (an explicit wire='pack' still overrides — identity
+            # mismatch below, fresh u24 pack)
+            fmt = "d24v"
     if resume and os.path.exists(jpath) and os.path.exists(tmp):
         jr = Journal(jpath)
         best = None
@@ -986,14 +1409,17 @@ def pack_file(path: str, out_path: str, cls: int = 64,
         ident = {"n": n, "window": window, "cls": cls,
                  "precompacted": bool(precompacted), "fp": fp, "fmt": fmt,
                  "bw": bw}
+        out_bytes_seen: list[int] = []   # out_bytes after batch j, in order
         for b in range(n_batches):
             rec = jr.get({"batch": b})
             if rec is None:
                 break
             if any(rec.get(k) != v for k, v in ident.items()):
                 best = None   # journal from a different pack run
+                out_bytes_seen = []
                 break
             best = rec
+            out_bytes_seen.append(rec["out_bytes"])
         if best is not None and os.path.getsize(tmp) < best["out_bytes"]:
             # the journal line outlived the data it describes (e.g. a
             # power loss between data flush and durability): truncating
@@ -1006,6 +1432,8 @@ def pack_file(path: str, out_path: str, cls: int = 64,
         if best is not None:
             b0 = best["key"]["batch"] + 1
             comp = _Compactor.restore(best["comp"])
+            # record b starts where batch b-1's bytes ended
+            offsets = [0] + out_bytes_seen[:b0 - 1]
             with open(tmp, "r+b") as out:
                 out.truncate(best["out_bytes"])
             import sys
@@ -1022,20 +1450,52 @@ def pack_file(path: str, out_path: str, cls: int = 64,
             os.unlink(jpath)
         except OSError:
             pass
+        offsets = []
     journal = Journal(jpath)
-    with obs.span("trace.pack_file", refs=n, fmt=fmt, resume_batch=b0), \
-            open(path, "rb") as f, open(tmp, "r+b" if b0 else "wb") as out:
-        f.seek(b0 * batch * 8)
-        out.seek(0, os.SEEK_END)
+
+    read_raw = _extent_reader(path, batch, n)
+    compact_batch = _compact_stage(comp, shift, precompacted, snapshot=True)
+
+    def encode_rec(b, mid):
+        """The on-disk record bytes of one batch (parallel across pool
+        workers).  An over-2^24 table skips encoding — the consumer
+        restarts the whole pack on the wide wire before writing it."""
+        ids, nl, snap = mid
+        if not _wide and nl >= 1 << 24:
+            return None, nl, snap
+        if fmt == "d24v":
+            from pluss.ops import wirecodec
+
+            payload, wm = wirecodec.encode_d24v(ids)
+            used = wirecodec.used_bytes(wm)
+            rec = (np.asarray([used], dtype="<u4"), wm, payload[:used])
+        elif _wide:
+            rec = (np.ascontiguousarray(ids, dtype="<i4"),)
+        else:
+            rec = (_pack24(ids),)
+        return rec, nl, snap
+
+    def items():
         for b in range(b0, n_batches):
             faults.check("trace.read_batch")  # chaos injection site
-            raw = np.fromfile(f, dtype="<u8", count=min(batch, n - b * batch))
-            ids = comp.map_raw(raw, 0 if precompacted else shift)
-            if ids is None:
-                lines = raw.astype(np.int64) if precompacted \
-                    else raw.astype(np.int64) >> shift
-                ids = comp.map(lines)
-            if not _wide and comp.next_free >= 1 << 24:
+            yield encode_rec(b, compact_batch(b, read_raw(b)))
+
+    import contextlib
+
+    if workers > 1:
+        src = _FeedPool(b0, n_batches,
+                        lambda b: faults.check("trace.read_batch"),
+                        read_raw, compact_batch, encode_rec, workers,
+                        depth=2)
+    else:
+        src = contextlib.nullcontext(items())
+    with obs.span("trace.pack_file", refs=n, fmt=fmt, resume_batch=b0,
+                  feed_workers=workers), \
+            src as it, open(tmp, "r+b" if b0 else "wb") as out:
+        out.seek(0, os.SEEK_END)
+        for b, item in zip(range(b0, n_batches), it):
+            rec, nl, snap = item
+            if not _wide and nl >= 1 << 24:
                 import sys
 
                 print(f"trace: line table overflowed 2^24 ids at batch "
@@ -1051,18 +1511,18 @@ def pack_file(path: str, out_path: str, cls: int = 64,
                     pass
                 return pack_file(path, out_path, cls, window,
                                 precompacted, limit_refs, _wide=True,
-                                batch_windows=bw)
-            if _wide:
-                ids.astype("<i4").tofile(out)
-            else:
-                _pack24(ids).tofile(out)
+                                batch_windows=bw, feed_workers=workers)
+            if fmt == "d24v":
+                offsets.append(out.tell())
+            for arr in rec:
+                arr.tofile(out)
             out.flush()
             # the DATA must be durable before the journal line that
             # promises it exists — otherwise a power loss can leave a
             # journal entry pointing past the real end of the file
             os.fsync(out.fileno())
             journal.record({"batch": b}, out_bytes=out.tell(),
-                           comp=comp.snapshot(), n=n, window=window,
+                           comp=snap, n=n, window=window,
                            cls=cls, precompacted=bool(precompacted),
                            fp=fp, fmt=fmt, bw=bw)
     os.replace(tmp, out_path)
@@ -1071,6 +1531,11 @@ def pack_file(path: str, out_path: str, cls: int = 64,
     # on them so a regenerated trace or a format change forces a repack
     meta = {"n": n, "n_lines": comp.next_free, "fmt": fmt,
             "src_fp": fp, "wire": WIRE_VERSION}
+    if fmt == "d24v":
+        # staging needs the record grid: records are variable-length and
+        # cut at the PACK-time batch, so replay must slice identically
+        meta["batch"] = batch
+        meta["offsets"] = offsets
     with open(out_path + ".json", "w") as f:
         json.dump(meta, f)
     try:
@@ -1141,7 +1606,8 @@ def replay_resident(packed_path: str, meta: dict,
                     clock0: int = 0,
                     stats: dict | None = None,
                     batch_windows: int | None = None,
-                    segmented: bool | None = None) -> ReplayResult:
+                    segmented: bool | None = None,
+                    feed_workers: int | None = None) -> ReplayResult:
     """Replay from DEVICE memory: stage the packed trace into HBM once,
     then run the whole scan in one dispatch at device rate.
 
@@ -1159,7 +1625,7 @@ def replay_resident(packed_path: str, meta: dict,
     """
     resident, n_run, stats2 = stage_resident(
         packed_path, meta, window, limit_refs, upload_budget_s,
-        batch_windows=batch_windows)
+        batch_windows=batch_windows, feed_workers=feed_workers)
     if stats is not None:
         stats.update(stats2)
     if n_run == 0:
@@ -1172,55 +1638,118 @@ def stage_resident(packed_path: str, meta: dict,
                    window: int = TRACE_WINDOW,
                    limit_refs: int | None = None,
                    upload_budget_s: float | None = None,
-                   batch_windows: int | None = None):
+                   batch_windows: int | None = None,
+                   feed_workers: int | None = None):
     """Upload a packed trace into HBM.  Returns ``(resident, n_run, stats)``
     — the device array ([n_batches, batch_windows, window, 3|4] u8 —
-    last dim per the ``u24``/``i32`` wire format), the staged ref count
+    last dim 3 for ``u24``/``d24v``, 4 for ``i32``), the staged ref count
     (may be a prefix under ``upload_budget_s``), and ``{upload_s,
     upload_bytes}``.  Staging once serves any number of
     :func:`replay_staged` calls (which read the batch width back off the
-    resident array's shape)."""
+    resident array's shape).
+
+    Reads ride the same ``feed_workers`` pool as :func:`replay_file`, so
+    disk reads of record ``b+1`` overlap the (async) upload of ``b``; a
+    ``d24v`` pack ships its COMPRESSED records over the transport and a
+    jitted kernel decodes them straight into the resident u24 layout —
+    PCIe carries a fraction of the 3 GB the u24 pack would ship.
+    """
     import time
 
-    if meta["fmt"] not in ("u24", "i32"):
+    if meta["fmt"] not in ("u24", "i32", "d24v"):
         raise ValueError(f"unknown packed trace format {meta['fmt']!r}")
-    bpr = 3 if meta["fmt"] == "u24" else 4   # wire bytes per ref
+    d24v = meta["fmt"] == "d24v"
+    bpr = 4 if meta["fmt"] == "i32" else 3   # resident HBM bytes per ref
     n = meta["n"] if limit_refs is None else min(meta["n"], limit_refs)
     if n == 0:
         return None, 0, {"upload_s": 0.0, "upload_bytes": 0}
     bw = _resolve_bw(batch_windows)
     batch = bw * window
     n_batches = -(-n // batch)
-    stage = _stage_fn(jax.default_backend())
+    backend = jax.default_backend()
+    stage = _stage_fn(backend)
+    workers = _resolve_feed_workers(feed_workers)
+    if d24v:
+        if meta.get("batch") != batch:
+            raise ValueError(
+                f"d24v pack {packed_path} was cut at {meta.get('batch')} "
+                f"refs/batch; this replay slices at {batch} "
+                "(batch_windows * window) — match the pack's batching or "
+                "repack")
+        offsets = meta["offsets"]
+        dec = _stage_decode_fn(backend)
+
+    def read_fixed(b):
+        """One fixed-width record, zero-padded to the batch shape."""
+        with open(packed_path, "rb") as f:
+            f.seek(b * batch * bpr)
+            raw = np.fromfile(f, dtype=np.uint8,
+                              count=min(batch, n - b * batch) * bpr)
+        rec_bytes = len(raw)
+        pad = batch * bpr - rec_bytes
+        if pad:
+            raw = np.concatenate([raw, np.zeros(pad, np.uint8)])
+        return raw, rec_bytes
+
+    def read_d24v(b):
+        """One compressed record (header | width map | payload), padded
+        for the decode kernel.  Truncation is a classified DataLoss
+        naming the record — never a silent short decode."""
+        from pluss.ops import wirecodec
+        from pluss.resilience.errors import DataLoss
+
+        count = min(batch, meta["n"] - b * batch)
+        nb_blocks = -(-count // wirecodec.BLOCK)
+        with open(packed_path, "rb") as f:
+            f.seek(offsets[b])
+            hdr = np.fromfile(f, dtype="<u4", count=1)
+            wm = np.fromfile(f, dtype=np.uint8, count=nb_blocks)
+            used = int(hdr[0]) if hdr.size else -1
+            payload = np.fromfile(f, dtype=np.uint8, count=max(used, 0))
+        if used < 0 or wm.size != nb_blocks or payload.size != used:
+            raise DataLoss(
+                f"truncated d24v pack {packed_path}: record {b} at byte "
+                f"offset {offsets[b]} is cut short", site="trace.load")
+        pp = np.zeros(wirecodec.pad_len(used), np.uint8)
+        pp[:used] = payload
+        return (pp, wm, count), 4 + wm.nbytes + used
+
+    read_rec = read_d24v if d24v else read_fixed
+    import contextlib
+
+    if workers > 1:
+        src = _FeedPool(0, n_batches, lambda b: None, read_rec,
+                        lambda b, raw: raw, lambda b, mid: mid,
+                        workers, depth=2)
+    else:
+        src = contextlib.nullcontext(read_rec(b) for b in range(n_batches))
 
     t0 = time.perf_counter()
     with obs.span("trace.stage_resident", refs=n, fmt=meta["fmt"],
-                  batch_windows=bw) as sp:
+                  batch_windows=bw, feed_workers=workers) as sp, \
+            src as it:
         resident = jnp.zeros((n_batches, bw, window, bpr), jnp.uint8)
         staged = 0
         payload_bytes = 0   # real file bytes, excluding final-batch padding
-        with open(packed_path, "rb") as f:
-            for b in range(n_batches):
-                raw = np.fromfile(f, dtype=np.uint8,
-                                  count=min(batch, n - b * batch) * bpr)
-                payload_bytes += len(raw)
-                pad = batch * bpr - len(raw)
-                if pad:
-                    raw = np.concatenate([raw, np.zeros(pad, np.uint8)])
-                resident = stage(
-                    resident,
-                    jnp.asarray(raw.reshape(1, bw, window, bpr)),
-                    jnp.int32(b))
-                staged = b + 1
-                if upload_budget_s is not None and staged < n_batches \
-                        and staged % 16 == 0:
-                    # transfers are ASYNC: without a periodic sync the loop
-                    # finishes in milliseconds and the budget check never
-                    # sees real elapsed time (observed: 427s staged past a
-                    # 300s cap)
-                    np.asarray(resident[0, 0, 0, :1])
-                    if time.perf_counter() - t0 > upload_budget_s:
-                        break
+        for b, (raw, rec_bytes) in zip(range(n_batches), it):
+            payload_bytes += rec_bytes
+            if d24v:
+                pp, wm, count = raw
+                chunk = dec(jnp.asarray(pp), jnp.asarray(wm), count,
+                            batch).reshape(1, bw, window, 3)
+            else:
+                chunk = jnp.asarray(raw.reshape(1, bw, window, bpr))
+            resident = stage(resident, chunk, jnp.int32(b))
+            staged = b + 1
+            if upload_budget_s is not None and staged < n_batches \
+                    and staged % 16 == 0:
+                # transfers are ASYNC: without a periodic sync the loop
+                # finishes in milliseconds and the budget check never
+                # sees real elapsed time (observed: 427s staged past a
+                # 300s cap)
+                np.asarray(resident[0, 0, 0, :1])
+                if time.perf_counter() - t0 > upload_budget_s:
+                    break
         np.asarray(resident[0, 0, 0, :1])  # force staging completion (tiny
         # d2h; block_until_ready does not actually wait over the tunnel)
         upload_s = time.perf_counter() - t0
@@ -1231,7 +1760,8 @@ def stage_resident(packed_path: str, meta: dict,
         # budget-shrunk prefix: keep only the staged leading batches
         resident = jax.lax.slice_in_dim(resident, 0, staged, axis=0)
     return resident, min(n, staged * batch), {
-        "upload_s": upload_s, "upload_bytes": staged * batch * bpr}
+        "upload_s": upload_s,
+        "upload_bytes": payload_bytes if d24v else staged * batch * bpr}
 
 
 def replay_staged(resident, n_lines: int, n_run: int,

@@ -2,14 +2,21 @@
 
 Exercises the full trace path end-to-end on a ~1e6-ref synthetic trace in
 seconds on the CPU backend, so every PR proves the replay pipeline —
-reader thread → compactor → pack → double-buffered h2d → segmented kernel
-— instead of leaving it to the (budget-gated, weather-dependent) bench:
+parallel reader/packer pool → compactor turnstile → compressed wire →
+staged-ahead h2d → segmented kernel — instead of leaving it to the
+(budget-gated, weather-dependent) bench:
 
-1. streamed replay (:func:`pluss.trace.replay_file`, the production path);
-2. ``pack_file`` → ``replay_resident`` bit-identity with the stream;
-3. a fault-interrupted checkpointed run resumed via ``--resume``
-   semantics, bit-identical to the uninterrupted replay;
-4. the legacy per-window scan (``segmented=False``) A/B bit-identity.
+1. streamed replay through the PRODUCTION feed: the d24v compressed wire
+   (device-side decode) fed by a 2-worker parallel pool
+   (:func:`pluss.trace.replay_file`);
+2. ``pack_file`` → ``replay_resident`` bit-identity with the stream, on
+   BOTH pack formats (fixed-width u24 and compressed d24v records);
+3. a fault-interrupted checkpointed run — same parallel feed + compressed
+   wire — resumed via ``--resume`` semantics, bit-identical to the
+   uninterrupted replay;
+4. the legacy per-window scan (``segmented=False``) under the
+   single-reader, fixed-width-pack feed — one step that A/Bs the kernel,
+   the pool, AND the wire against step 1.
 
 Run directly (``python -m pluss.trace_smoke``) or through the pytest
 wrapper in tests/test_trace.py.  Pins the CPU backend unless
@@ -42,32 +49,52 @@ def main(n_refs: int = 1 << 20, window: int = 1 << 14,
         rng.shuffle(lines)
         (lines.astype(np.uint64) << np.uint64(6)).astype("<u8").tofile(path)
 
-        # segmented=True explicitly: the smoke runs on CPU, where the
-        # backend default is the legacy scan — the production (TPU)
-        # kernel must still be the one exercised on every PR
+        # segmented=True + wire/workers explicitly: the smoke runs on CPU,
+        # where the backend defaults are the legacy scan, the plain pack,
+        # and a single reader — the production (accelerator) pipeline
+        # must still be the one exercised on every PR
         ref = trace.replay_file(path, window=window,
                                 batch_windows=batch_windows,
-                                segmented=True)
+                                segmented=True, wire="d24v",
+                                feed_workers=2)
         assert ref.total_count == n_refs, \
             f"streamed replay covered {ref.total_count}/{n_refs} refs"
 
         packed = os.path.join(td, "smoke.pack")
         meta = trace.pack_file(path, packed, window=window,
                                batch_windows=batch_windows)
+        assert meta["fmt"] == "u24", meta
         res = trace.replay_resident(packed, meta, window=window,
                                     batch_windows=batch_windows,
                                     segmented=True)
         np.testing.assert_array_equal(res.hist, ref.hist,
                                       "resident replay != streamed replay")
 
-        # interrupt a checkpointed run mid-stream (16 batches at these
-        # shapes; the injected DataLoss fires on the 8th batch read, after
-        # checkpoints at b=2,4,6), then resume — must be bit-identical
+        # compressed-wire pack: parallel-pool encode, device-side decode
+        # at staging — must reproduce the same histogram from fewer
+        # transported bytes
+        packed_c = os.path.join(td, "smoke.d24v")
+        meta_c = trace.pack_file(path, packed_c, window=window,
+                                 batch_windows=batch_windows,
+                                 wire="d24v", feed_workers=2)
+        assert meta_c["fmt"] == "d24v", meta_c
+        assert os.path.getsize(packed_c) < os.path.getsize(packed), \
+            "d24v pack is not smaller than the u24 pack on a hot/warm trace"
+        res_c = trace.replay_resident(packed_c, meta_c, window=window,
+                                      batch_windows=batch_windows,
+                                      segmented=True, feed_workers=2)
+        np.testing.assert_array_equal(
+            res_c.hist, ref.hist, "d24v resident replay != streamed replay")
+
+        # interrupt a checkpointed PARALLEL-FEED run mid-stream (16
+        # batches at these shapes; the injected DataLoss fires on the 8th
+        # batch claim), then resume — must be bit-identical
         ckpt = os.path.join(td, "smoke.ckpt.npz")
         faults.install(faults.FaultPlan.parse("trace_loss@8"))
         try:
             trace.replay_file(path, window=window,
                               batch_windows=batch_windows, segmented=True,
+                              wire="d24v", feed_workers=2,
                               checkpoint_path=ckpt, checkpoint_every=2)
             raise AssertionError("injected trace_loss fault did not fire")
         except DataLoss:
@@ -77,21 +104,27 @@ def main(n_refs: int = 1 << 20, window: int = 1 << 14,
         assert os.path.exists(ckpt), "no checkpoint written before the fault"
         resumed = trace.replay_file(path, window=window,
                                     batch_windows=batch_windows,
-                                    segmented=True,
+                                    segmented=True, wire="d24v",
+                                    feed_workers=2,
                                     checkpoint_path=ckpt, resume=True)
         np.testing.assert_array_equal(resumed.hist, ref.hist,
                                       "resumed replay != uninterrupted")
         assert not os.path.exists(ckpt), \
             "finished resumed run did not retire its checkpoint"
 
+        # legacy kernel under the single-reader fixed-width feed: one A/B
+        # across the kernel, the pool, and the wire at once
         legacy = trace.replay_file(path, window=window,
                                    batch_windows=batch_windows,
-                                   segmented=False)
+                                   segmented=False, wire="pack",
+                                   feed_workers=1)
         np.testing.assert_array_equal(legacy.hist, ref.hist,
-                                      "legacy per-window scan != segmented")
+                                      "legacy scan/serial feed != segmented"
+                                      "/parallel d24v")
 
     print(f"trace smoke OK: {n_refs} refs over {ref.n_lines} line slots; "
-          "stream == resident == resumed == legacy-scan", file=sys.stderr)
+          "parallel-d24v stream == resident(u24) == resident(d24v) == "
+          "resumed == legacy-serial-pack", file=sys.stderr)
     return 0
 
 

@@ -26,6 +26,27 @@ def env_float(name: str, default: float, minimum: float) -> float:
     return _parse(name, os.environ.get(name, ""), default, minimum, float)
 
 
+def env_choice(name: str, default: str, choices: tuple[str, ...]) -> str:
+    """Enumerated env knob (e.g. PLUSS_WIRE): unknown values warn once
+    and fall back to the default, same policy as the numeric knobs."""
+    return _parse_choice(name, os.environ.get(name, ""), default,
+                         tuple(choices))
+
+
+@functools.lru_cache(maxsize=64)
+def _parse_choice(name: str, raw: str, default: str,
+                  choices: tuple[str, ...]) -> str:
+    v = raw.strip()
+    if not v:
+        return default
+    if v not in choices:
+        print(f"pluss: ignoring unknown {name}={raw!r} (choices: "
+              f"{', '.join(choices)}); using the default {default!r}",
+              file=sys.stderr)
+        return default
+    return v
+
+
 @functools.lru_cache(maxsize=64)
 def _parse(name: str, raw: str, default, minimum, conv):
     if not raw.strip():

@@ -21,9 +21,11 @@ python -m pluss.cli lint --all 1>&2
 # still pure host analysis, ~20 s for the registry at default sizes.
 python -m pluss.cli analyze --all 1>&2
 
-# trace replay smoke (tier-1): pack_file → replay_file → fault-interrupted
-# checkpoint --resume equivalence + legacy-kernel A/B on a ~1e6-ref
-# synthetic trace, pinned to the CPU backend (~10 s).  The replay path is
+# trace replay smoke (tier-1): compressed-wire (d24v) pack → parallel-feed
+# replay → fault-interrupted checkpoint --resume equivalence + legacy-
+# kernel/serial-feed/plain-pack A/B on a ~1e6-ref synthetic trace, pinned
+# to the CPU backend (~10 s).  The replay pipeline — worker pool,
+# compactor turnstile, device-side wire decode, staged-ahead h2d — is
 # exercised on every PR, not just in the budget-gated bench.  Runs with
 # the telemetry sink ARMED, and the emitted event stream must pass the
 # schema check (`pluss stats --check`) — an observability regression

@@ -265,11 +265,15 @@ GOLDEN_RECORDS = [
     {"ev": "counter", "name": "trace.batches", "value": 5, "t": 2.0},
     {"ev": "counter", "name": "trace.h2d_bytes", "value": 1000000.0,
      "t": 2.0},
+    {"ev": "counter", "name": "trace.device_bytes", "value": 4000000.0,
+     "t": 2.0},
+    {"ev": "counter", "name": "trace.wire_encode_s", "value": 0.8,
+     "t": 2.0},
     {"ev": "end", "dur": 2.1},
 ]
 
 GOLDEN_OUTPUT = """\
-telemetry stream: 11 records, 2 span(s), 1 event(s)
+telemetry stream: 13 records, 2 span(s), 1 event(s)
 spans:
   span                                           n       total       self
   trace.replay_file                              1      2.000s     1.750s
@@ -278,10 +282,12 @@ events:
   resilience.fault_injected                        1
 counters:
   trace.batches                                         5
+  trace.device_bytes                              4000000
   trace.device_s                                     0.25
   trace.h2d_bytes                                 1000000
   trace.h2d_s                                         0.5
   trace.prefetch_stall_s                                1
+  trace.wire_encode_s                                 0.8
 gauges (last value):
   trace.queue_occupancy                                 2
 trace replay breakdown:
@@ -291,6 +297,8 @@ trace replay breakdown:
   device compute                   0.250s   12.5%  (0.0500s/batch over 5 batches)
   accounted                        1.750s of 2.000s wall (87.5%)
   h2d rate                           2.0 MB/s
+  wire encode (feed workers)       0.800s  (concurrent)
+  wire compression                   1.0 MB wire vs 4.0 MB device (4.00x)
 """
 
 

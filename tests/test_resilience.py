@@ -406,6 +406,58 @@ def test_replay_resilient_classifies_data_loss(tmp_path):
         replay_file_resilient(tf, window=512, retry=Retry(backoff_s=0))
 
 
+def test_replay_resilient_serial_feed_rung(tmp_path, monkeypatch):
+    """The trace ladder's FIRST rung drops the parallel pool + compressed
+    wire back to the single reader + fixed-width pack — and only that:
+    the window is untouched, and the degraded result is bit-identical."""
+    from pluss.resilience.errors import ResourceExhausted
+
+    tf, _ = _mk_trace(tmp_path)
+    ref = trace.replay_file(tf, window=512)
+    real = trace.replay_file
+    calls = []
+
+    def flaky(path, fmt="u64", **kw):
+        calls.append(kw)
+        if len(calls) == 1:
+            # a degradable failure on the pooled/compressed attempt (the
+            # shape an overdeep in-flight pipeline would OOM with)
+            raise ResourceExhausted("synthetic", site="trace.replay")
+        return real(path, fmt, **kw)
+
+    monkeypatch.setattr(trace, "replay_file", flaky)
+    res = replay_file_resilient(tf, window=512, wire="d24v",
+                                feed_workers=3, retry=Retry(backoff_s=0))
+    assert res.degradations == ("serial_feed",)
+    assert calls[0]["feed_workers"] == 3 and calls[0]["wire"] == "d24v"
+    assert calls[1]["feed_workers"] == 1 and calls[1]["wire"] == "pack"
+    assert calls[1]["window"] == 512          # rung sheds the feed ONLY
+    # the result records the feed the SUCCESSFUL attempt ran (what bench
+    # stamps on the metric line), not the pre-degradation request
+    assert res.wire == "pack" and res.feed_workers == 1
+    np.testing.assert_array_equal(res.hist, ref.hist)
+
+    # CHECKPOINTED runs keep their wire across the rung (it is part of
+    # the checkpoint identity — flipping it would discard the durable
+    # prefix as a "different run"), and an unset wire is pinned to its
+    # auto-resolution up-front for the same reason
+    calls.clear()
+    ck = str(tmp_path / "rung.ckpt.npz")
+    res = replay_file_resilient(tf, window=512, wire="d24v",
+                                feed_workers=3, checkpoint_path=ck,
+                                retry=Retry(backoff_s=0))
+    assert res.degradations == ("serial_feed",)
+    assert calls[1]["feed_workers"] == 1 and calls[1]["wire"] == "d24v"
+    np.testing.assert_array_equal(res.hist, ref.hist)
+    calls.clear()
+    res = replay_file_resilient(tf, window=512, checkpoint_path=ck,
+                                wire="auto", retry=Retry(backoff_s=0))
+    # an unset OR explicit-`auto` wire is pinned to its resolution
+    # up-front — `auto` must not re-resolve differently mid-run
+    assert calls[0]["wire"] == trace._resolve_wire("auto")
+    np.testing.assert_array_equal(res.hist, ref.hist)
+
+
 def test_replay_resilient_passes_batching_knobs_through(tmp_path):
     """The ladder wrapper forwards the round-6 feed knobs (batch_windows,
     queue_depth, segmented) untouched, and deadline truncation under the

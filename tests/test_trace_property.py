@@ -81,11 +81,12 @@ def check_kernel(seed: int, n_lines: int, fmt: str, tail: int) -> None:
     assert int(seg_hist.sum()) == n_valid   # cold + binned reuse partition
 
 
-def check_replay_file(seed: int, sparse: bool, bw: int,
-                      fault_at: int) -> None:
+def check_replay_file(seed: int, sparse: bool, bw: int, fault_at: int,
+                      wire: str = "pack", feed_workers: int = 1) -> None:
     """End-to-end replay_file: a tiny initial capacity forces device-table
     growth retraces mid-stream (sparse streams additionally exercise
-    cluster compaction), the legacy scan must agree exactly, and a
+    cluster compaction), the legacy scan over the plain u64 path must
+    agree exactly — under every (wire, feed_workers) feed — and a
     fault-interrupted checkpointed run resumed at an arbitrary split must
     be bit-identical to the uninterrupted replay."""
     from pluss.resilience import faults
@@ -99,16 +100,21 @@ def check_replay_file(seed: int, sparse: bool, bw: int,
         addrs = base[rng.integers(0, 30, n)]
     else:
         addrs = rng.integers(0, 1 << 10, n, dtype=np.int64) * 64
+    feed = {"wire": wire, "feed_workers": feed_workers}
     with tempfile.TemporaryDirectory() as td:
         p = os.path.join(td, "t.bin")
         addrs.astype("<u8").tofile(p)
         # segmented=True explicitly: on the CPU backend the default is the
-        # legacy scan, and the point is to cross-compare the two kernels
+        # legacy scan, and the point is to cross-compare the two kernels.
+        # The baseline `leg` run is the pre-round-6 path — legacy scan,
+        # plain pack, single reader — so a compressed-wire/pooled `ref`
+        # pins the whole new feed against the original u64 replay.
         ref = trace.replay_file(p, window=window, batch_windows=bw,
-                                initial_capacity=8, segmented=True)
+                                initial_capacity=8, segmented=True, **feed)
         assert ref.total_count == n
         leg = trace.replay_file(p, window=window, batch_windows=bw,
-                                initial_capacity=8, segmented=False)
+                                initial_capacity=8, segmented=False,
+                                wire="pack", feed_workers=1)
         np.testing.assert_array_equal(ref.hist, leg.hist)
 
         ckpt = os.path.join(td, "t.ckpt.npz")
@@ -117,7 +123,8 @@ def check_replay_file(seed: int, sparse: bool, bw: int,
             with pytest.raises(DataLoss):
                 trace.replay_file(p, window=window, batch_windows=bw,
                                   initial_capacity=8, segmented=True,
-                                  checkpoint_path=ckpt, checkpoint_every=1)
+                                  checkpoint_path=ckpt, checkpoint_every=1,
+                                  **feed)
         finally:
             faults.install(None)
         # an early fault may beat the first checkpoint write (the reader
@@ -125,7 +132,7 @@ def check_replay_file(seed: int, sparse: bool, bw: int,
         # either way the result must be bit-identical
         res = trace.replay_file(p, window=window, batch_windows=bw,
                                 initial_capacity=8, segmented=True,
-                                checkpoint_path=ckpt, resume=True)
+                                checkpoint_path=ckpt, resume=True, **feed)
         np.testing.assert_array_equal(res.hist, ref.hist)
         assert res.total_count == n
 
@@ -150,6 +157,17 @@ if HAVE_HYPOTHESIS:
                                                          fault_at):
         check_replay_file(seed, sparse, bw, fault_at)
 
+    @settings(max_examples=8, deadline=None)
+    @given(seed=st.integers(0, 2**32 - 1),
+           sparse=st.booleans(),
+           bw=st.sampled_from([2, 3]),
+           fault_at=st.integers(2, 6),
+           feed_workers=st.sampled_from([1, 3]))
+    def test_replay_file_d24v_parallel_feed_bit_identical(
+            seed, sparse, bw, fault_at, feed_workers):
+        check_replay_file(seed, sparse, bw, fault_at, wire="d24v",
+                          feed_workers=feed_workers)
+
 else:
 
     @pytest.mark.parametrize("fmt", WIRE_FORMATS)
@@ -166,3 +184,122 @@ else:
     def test_replay_file_growth_and_resume_bit_identical(seed, sparse, bw,
                                                          fault_at):
         check_replay_file(seed, sparse, bw, fault_at)
+
+    # the round-7 feed: compressed d24v wire (device-side decode) under
+    # single-reader AND pooled feeds, same growth/carry/ragged-tail/
+    # fault-split matrix, pinned against the plain u64 legacy path
+    @pytest.mark.parametrize("seed,sparse,bw,fault_at,feed_workers",
+                             [(20, False, 2, 4, 1), (21, True, 3, 2, 3),
+                              (22, True, 2, 6, 3), (23, False, 3, 5, 2)])
+    def test_replay_file_d24v_parallel_feed_bit_identical(
+            seed, sparse, bw, fault_at, feed_workers):
+        check_replay_file(seed, sparse, bw, fault_at, wire="d24v",
+                          feed_workers=feed_workers)
+
+
+def test_checkpoint_never_splices_across_wires(tmp_path, capsys):
+    """A resume whose wire differs from the checkpoint's must start
+    fresh (histograms are wire-invariant, but a splice would silently
+    blend two encodings of one stream — same rule as batch_windows)."""
+    from pluss.resilience import faults
+    from pluss.resilience.errors import DataLoss
+
+    window, bw = 1 << 8, 2
+    rng = np.random.default_rng(7)
+    addrs = rng.integers(0, 1 << 10, bw * window * 8, dtype=np.int64) * 64
+    p = str(tmp_path / "t.bin")
+    addrs.astype("<u8").tofile(p)
+    ref = trace.replay_file(p, window=window, batch_windows=bw,
+                            segmented=True, wire="pack")
+    ckpt = str(tmp_path / "t.ckpt.npz")
+    faults.install(faults.FaultPlan.parse("trace_loss@5"))
+    try:
+        with pytest.raises(DataLoss):
+            trace.replay_file(p, window=window, batch_windows=bw,
+                              segmented=True, wire="d24v",
+                              checkpoint_path=ckpt, checkpoint_every=1)
+    finally:
+        faults.install(None)
+    assert os.path.exists(ckpt)
+    res = trace.replay_file(p, window=window, batch_windows=bw,
+                            segmented=True, wire="pack",
+                            checkpoint_path=ckpt, resume=True)
+    assert "different run" in capsys.readouterr().err
+    np.testing.assert_array_equal(res.hist, ref.hist)
+
+
+def test_pack_file_d24v_resume_byte_identical(tmp_path):
+    """A fault-interrupted d24v pack resumed from its journal must be
+    byte-identical to the uninterrupted pack — record offsets in the
+    sidecar included (the resume reconstructs them from the journal's
+    out_bytes trail)."""
+    from pluss.resilience import faults
+    from pluss.resilience.errors import DataLoss
+
+    window, bw = 1 << 8, 2
+    rng = np.random.default_rng(11)
+    addrs = rng.integers(0, 1 << 10, bw * window * 8 - 37,
+                         dtype=np.int64) * 64
+    p = str(tmp_path / "t.bin")
+    addrs.astype("<u8").tofile(p)
+    clean = str(tmp_path / "clean.pack")
+    meta_clean = trace.pack_file(p, clean, window=window, batch_windows=bw,
+                                 wire="d24v")
+    assert meta_clean["fmt"] == "d24v"
+    crash = str(tmp_path / "crash.pack")
+    faults.install(faults.FaultPlan.parse("trace_loss@5"))
+    try:
+        with pytest.raises(DataLoss):
+            trace.pack_file(p, crash, window=window, batch_windows=bw,
+                            wire="d24v")
+    finally:
+        faults.install(None)
+    assert os.path.exists(crash + ".journal")
+    # resume WITHOUT re-passing wire='d24v': the journal's fmt must keep
+    # the pack d24v (the i32-fallback continuation rule, same format
+    # class) — only an explicit wire='pack' may override to a fresh u24
+    meta = trace.pack_file(p, crash, window=window, batch_windows=bw,
+                           resume=True)
+    assert meta == meta_clean      # offsets grid included
+    with open(clean, "rb") as a, open(crash, "rb") as b:
+        assert a.read() == b.read()
+
+
+def test_pack_file_d24v_rejects_oversized_batch(tmp_path):
+    """The decode kernel's bit offsets are int32; a pack cut at a batch
+    past the ceiling would decode garbage at stage time, so pack_file
+    must refuse it loudly up front."""
+    p = str(tmp_path / "t.bin")
+    np.zeros(8, "<u8").tofile(p)
+    with pytest.raises(ValueError, match="refs/batch"):
+        trace.pack_file(p, str(tmp_path / "o.pack"), wire="d24v",
+                        window=1 << 20, batch_windows=128)
+
+
+def test_feed_worker_and_wire_knob_validation(tmp_path, monkeypatch,
+                                              capsys):
+    """Explicit bad values fail loudly at every entry; malformed env
+    knobs warn and fall back (the PR-4 PLUSS_BATCH_WINDOWS policy)."""
+    addrs = (np.arange(4096, dtype=np.int64) % 64) * 64
+    p = str(tmp_path / "t.bin")
+    addrs.astype("<u8").tofile(p)
+    for bad in (0, -2):
+        with pytest.raises(ValueError, match="feed_workers"):
+            trace.replay_file(p, feed_workers=bad)
+        with pytest.raises(ValueError, match="feed_workers"):
+            trace.pack_file(p, str(tmp_path / "o.pack"), feed_workers=bad)
+    with pytest.raises(ValueError, match="wire"):
+        trace.replay_file(p, wire="gzip")
+    with pytest.raises(ValueError, match="wire"):
+        trace.pack_file(p, str(tmp_path / "o.pack"), wire="gzip")
+    with pytest.raises(ValueError, match="stage_depth"):
+        trace.replay_file(p, stage_depth=0)
+    # malformed envs: warn-once + default, never crash (lru_cache on the
+    # parser memoizes per (name, raw) pair, so fresh raws re-warn)
+    monkeypatch.setenv("PLUSS_FEED_WORKERS", "many!")
+    monkeypatch.setenv("PLUSS_WIRE", "zstd??")
+    r = trace.replay_file(p, window=1 << 10)
+    assert r.total_count == 4096
+    err = capsys.readouterr().err
+    assert "PLUSS_FEED_WORKERS" in err
+    assert "PLUSS_WIRE" in err

@@ -1,0 +1,126 @@
+"""d24v wire codec: host encode ≡ device decode, adversarial patterns.
+
+The compressed trace wire (pluss/ops/wirecodec.py) must round-trip every
+id pattern bit-exactly — the streamed replay's histograms are pinned
+bit-identical to the u64 path, so a single mis-decoded id anywhere would
+fail the property suite loudly.  This file hits the codec directly at
+its edge cases: block-width boundaries, raw/delta mode flips, the
+cross-block carry reset-scan, ragged tails, and the format's ceilings.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pluss.ops import wirecodec as wc
+
+
+def roundtrip(ids: np.ndarray) -> np.ndarray:
+    payload, wm = wc.encode_d24v(ids)
+    assert payload.nbytes == wc.pad_len(wc.used_bytes(wm))
+    assert payload.nbytes % 4 == 0       # u32-word decode alignment
+    dec = np.asarray(wc.decode_d24v(jnp.asarray(payload), jnp.asarray(wm)))
+    assert dec.shape[0] % wc.BLOCK == 0  # whole blocks out
+    return dec[:len(ids)]
+
+
+PATTERNS = {
+    "random24": lambda rng: rng.integers(0, 1 << 24, 5000, dtype=np.int32),
+    "random16": lambda rng: rng.integers(0, 1 << 16, 4096, dtype=np.int32),
+    "sequential": lambda rng: np.arange(3000, dtype=np.int32),
+    # a scan high in a big table: global deltas keep it tiny even though
+    # every id needs 23 bits raw
+    "seq_high": lambda rng: (np.arange(5000, dtype=np.int32) % 4096)
+    + (1 << 22),
+    "constant": lambda rng: np.full(2500, 1234567, np.int32),
+    "zeros": lambda rng: np.zeros(700, np.int32),
+    "single": lambda rng: np.array([7], np.int32),
+    "extremes": lambda rng: np.array(
+        [0, (1 << 24) - 1, 1, (1 << 24) - 2] * 700, np.int32),
+    # alternating noisy (raw-mode) and sequential (delta-mode) blocks:
+    # the decoder's cross-block carry must survive every reset
+    "mode_flips": lambda rng: np.concatenate([
+        rng.integers(0, 1 << 23, wc.BLOCK, dtype=np.int32)
+        if i % 2 else np.arange(wc.BLOCK, dtype=np.int32) + (1 << 20)
+        for i in range(12)]),
+    # every nibble width in one batch: per-block maxima at each 4-bit
+    # boundary (1, 2^4-1, 2^8-1, ..., 2^24-1) in raw mode
+    "width_ladder": lambda rng: np.concatenate([
+        np.minimum(rng.integers(0, 1 << min(4 * k, 24), wc.BLOCK,
+                                dtype=np.int64),
+                   (1 << 24) - 1).astype(np.int32)
+        for k in range(7)]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PATTERNS))
+def test_roundtrip_patterns(name):
+    rng = np.random.default_rng(hash(name) % 2**32)
+    ids = PATTERNS[name](rng)
+    np.testing.assert_array_equal(roundtrip(ids), ids)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_roundtrip_random_ragged(seed):
+    """Random lengths straddling block boundaries (the encoder pads with
+    the last id; the decoder's tail must still slice back exactly)."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 4 * wc.BLOCK + 3))
+    hi = int(rng.integers(1, 24))
+    ids = rng.integers(0, 1 << hi, n, dtype=np.int32)
+    np.testing.assert_array_equal(roundtrip(ids), ids)
+
+
+def test_sequential_compresses_well():
+    """The point of the format: a sequential scan packs far under the
+    3 B/ref u24 wire (deltas of 1 are one nibble + headers)."""
+    ids = np.arange(16 * wc.BLOCK, dtype=np.int32) + (1 << 20)
+    _, wm = wc.encode_d24v(ids)
+    assert wc.used_bytes(wm) <= len(ids)   # <= 1 B/ref vs 3 B/ref u24
+
+
+def test_random_never_worse_than_raw_width():
+    """Uniform noise defeats delta coding; raw mode must cap the cost at
+    the plain pack's nibble-rounded width."""
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 1 << 24, 8 * wc.BLOCK, dtype=np.int32)
+    _, wm = wc.encode_d24v(ids)
+    assert wc.used_bytes(wm) <= 3 * len(ids)
+
+
+def test_rejects_out_of_range_and_empty():
+    with pytest.raises(ValueError, match="2\\*\\*24"):
+        wc.encode_d24v(np.array([1 << 24], np.int32))
+    with pytest.raises(ValueError, match="2\\*\\*24"):
+        wc.encode_d24v(np.array([-1], np.int32))
+    with pytest.raises(ValueError, match="empty"):
+        wc.encode_d24v(np.array([], np.int32))
+
+
+def test_pad_len_quantization_is_bounded():
+    """Payload padding must stay within ~12.5% + alignment (it is wire
+    overhead) while collapsing lengths to few distinct shapes."""
+    import random
+
+    random.seed(5)
+    for _ in range(200):
+        nbytes = random.randint(0, 1 << 27)
+        padded = wc.pad_len(nbytes)
+        assert padded >= nbytes + 4          # guard word always fits
+        assert padded % 4 == 0
+        assert padded <= max(nbytes * 1.14 + 4096, 8192)
+    # shape stability: nearby lengths share a padded size
+    assert len({wc.pad_len(x) for x in range(1 << 20, (1 << 20) + 5000)}) \
+        <= 2
+
+
+def test_used_bytes_matches_encoder():
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, 1 << 20, 3 * wc.BLOCK + 17, dtype=np.int32)
+    payload, wm = wc.encode_d24v(ids)
+    used = wc.used_bytes(wm)
+    # everything past `used` is pure padding the encoder never wrote
+    assert not payload[used:].any()
