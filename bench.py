@@ -565,6 +565,88 @@ def bench_trace(n_refs: int) -> None:
          shrunk=bool(n_run != n_refs), **obs_extra)
 
 
+def bench_serve(n_requests: int = 48) -> None:
+    """Serving headline (round r07 on): p50/p99 request latency and
+    throughput of an in-process ``pluss serve`` daemon under a mixed,
+    coalescible load — batched (max_batch=8) vs unbatched (max_batch=1)
+    A/B, so the record shows what shared-dispatch coalescing buys.
+    Latencies are CLIENT-side wall times (what a tenant experiences),
+    after a per-key warmup so compile time doesn't pollute the quantiles;
+    both arms run in one process, so plan/executable caches are equally
+    warm and the A/B isolates the batching discipline itself."""
+    import tempfile
+    import threading
+
+    from pluss.serve import Client, ServeConfig, Server
+
+    pool = [
+        {"model": "gemm", "n": 64, "threads": 4, "chunk": 4},
+        {"model": "syrk", "n": 32, "threads": 4, "chunk": 4},
+        {"model": "mvt", "n": 64, "threads": 4, "chunk": 4},
+    ]
+    results: dict[str, tuple[float, float, float]] = {}
+    for label, mb in (("batched", 8), ("unbatched", 1)):
+        sock = tempfile.mktemp(prefix="pluss_bench_serve_",
+                               suffix=".sock")
+        srv = Server(socket_path=sock,
+                     config=ServeConfig(max_batch=mb, max_delay_ms=5.0,
+                                        max_queue=256))
+        srv.start()
+        lat: list[float] = []
+        lock = threading.Lock()
+        try:
+            with Client(sock) as c:   # warm plans + executables per key
+                for q in pool:
+                    c.request(q)
+
+            def worker(chunk):
+                with Client(sock) as c:
+                    for q in chunk:
+                        t0 = time.perf_counter()
+                        r = c.request(q)
+                        dt = (time.perf_counter() - t0) * 1e3
+                        if r.get("ok"):
+                            with lock:
+                                lat.append(dt)
+
+            reqs = [dict(pool[i % len(pool)]) for i in range(n_requests)]
+            chunks = [reqs[i::4] for i in range(4)]
+            threads = [threading.Thread(target=worker, args=(ch,))
+                       for ch in chunks if ch]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        finally:
+            srv.shutdown()
+        if not lat:
+            raise RuntimeError(f"serve bench ({label}): no ok responses")
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+        results[label] = (p50, p99, len(lat) / wall)
+        log(f"bench: serve {label} p50 {p50:.1f} ms, p99 {p99:.1f} ms, "
+            f"{len(lat) / wall:.1f} req/s over {len(lat)} requests")
+    b, u = results["batched"], results["unbatched"]
+    # vs_baseline is "batched advantage": >1 means coalescing won
+    for i, (name, unit, vs) in enumerate((
+            ("serve_p50_ms", "ms", u[0] / b[0] if b[0] else None),
+            ("serve_p99_ms", "ms", u[1] / b[1] if b[1] else None),
+            ("serve_reqs_per_sec", "req/s", b[2] / u[2] if u[2] else None))):
+        print(json.dumps({
+            "metric": name,
+            "value": round_keep(b[i], 3),
+            "unit": unit,
+            "vs_baseline": round_keep(vs, 3),
+            "path": "serve_batched",
+            "degradations": [],
+            "unbatched": round_keep(u[i], 3),
+            "requests": n_requests,
+        }), flush=True)
+
+
 def main() -> int:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
     # persistent XLA compilation cache: the flagship compiles cost minutes
@@ -621,6 +703,10 @@ def main() -> int:
              path=engine.describe_path(gemm(128)),
              degradations=tuple(res.degradations),
              **analysis_fields(gemm(128)))
+        try:
+            bench_serve(24)
+        except Exception as e:
+            log(f"bench: serve metric failed: {e}")
         return 0
 
     # headline FIRST (round 3's record has rc=124 with this metric still
@@ -712,6 +798,14 @@ def main() -> int:
             bench_trace(trace_refs)
         except Exception as e:
             log(f"bench: trace metric failed: {e}")
+
+    # serving headline (round r07 on): what a tenant of `pluss serve`
+    # experiences — p50/p99 latency and req/s, batched vs unbatched A/B
+    if budget_ok("serve", 90):
+        try:
+            bench_serve()
+        except Exception as e:
+            log(f"bench: serve metric failed: {e}")
 
     # accuracy half of the north star (BASELINE.json: "miss-ratio-curve L2
     # error vs C++ baseline" within 1%): MRC of the TPU pipeline vs the

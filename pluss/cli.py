@@ -195,7 +195,8 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="pluss", description=__doc__)
     p.add_argument("mode",
                    choices=("acc", "speed", "mrc", "trace", "sweep",
-                            "sample", "lint", "analyze", "stats"))
+                            "sample", "lint", "analyze", "stats",
+                            "serve"))
     p.add_argument("target", nargs="?", default=None,
                    help="stats mode: telemetry event stream (events.jsonl) "
                         "to aggregate")
@@ -267,6 +268,37 @@ def main(argv: list[str] | None = None) -> int:
                         "PLUSS_WIRE env, else d24v on accelerators / "
                         "pack on CPU).  Histogram-invariant; part of the "
                         "checkpoint identity")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="serve mode: unix socket path to listen on")
+    p.add_argument("--port", type=int, default=None,
+                   help="serve mode: TCP port to listen on (0 = ephemeral; "
+                        "bound address printed on stderr)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="serve mode: TCP bind host (default 127.0.0.1)")
+    p.add_argument("--max-queue", type=int, default=128,
+                   help="serve mode: admission bound — requests past this "
+                        "queue depth are SHED with a typed Overloaded "
+                        "error instead of queued")
+    p.add_argument("--max-batch", type=int, default=16,
+                   help="serve mode: most requests one shared dispatch "
+                        "may coalesce (1 disables batching)")
+    p.add_argument("--max-delay-ms", type=float, default=10.0,
+                   help="serve mode: adaptive batch window — the longest "
+                        "a request waits for compatible stragglers before "
+                        "dispatching as-is")
+    p.add_argument("--default-deadline-ms", type=float, default=None,
+                   help="serve mode: default per-request deadline for "
+                        "requests that do not carry deadline_ms")
+    p.add_argument("--heartbeat-dir", default=None, metavar="DIR",
+                   help="serve mode: multihost heartbeat directory to "
+                        "export heartbeat_age_s gauges from on the "
+                        "prometheus refresh timer")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="serve mode: worker count watched under "
+                        "--heartbeat-dir")
+    p.add_argument("--prom-refresh-s", type=float, default=5.0,
+                   help="serve mode: SLO gauge + prometheus textfile "
+                        "(PLUSS_PROM) refresh period")
     p.add_argument("--start-point", type=int, default=None,
                    help="resume sampling from this parallel-loop iteration "
                         "value (the reference's setStartPoint capability)")
@@ -337,6 +369,41 @@ def main(argv: list[str] | None = None) -> int:
             print("pluss: no usable accelerator, falling back to CPU",
                   file=sys.stderr)
             force_cpu(8)
+
+    if args.mode == "serve":
+        # the long-lived multi-tenant prediction daemon (pluss/serve):
+        # JSONL requests over a unix socket or localhost TCP, shared-
+        # dispatch batching, per-request resilience, SLO telemetry
+        from pluss.serve import ServeConfig, Server
+
+        if (args.socket is None) == (args.port is None):
+            p.error("serve mode requires exactly one of --socket/--port")
+        scfg = ServeConfig(
+            max_queue=args.max_queue,
+            max_batch=args.max_batch,
+            max_delay_ms=args.max_delay_ms,
+            default_deadline_ms=args.default_deadline_ms,
+            prom_refresh_s=args.prom_refresh_s,
+            heartbeat_dir=args.heartbeat_dir,
+            num_processes=args.num_processes,
+        )
+        server = Server(socket_path=args.socket, port=args.port,
+                        host=args.host, config=scfg)
+        try:
+            server.start()
+        except OSError as e:
+            print(f"pluss serve: cannot bind {args.socket or args.port}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        print(f"pluss serve: listening on {server.address} "
+              f"(max_queue={scfg.max_queue}, max_batch={scfg.max_batch}, "
+              f"max_delay_ms={scfg.max_delay_ms:g}); SIGTERM or a "
+              '{"op": "shutdown"} line drains and stops', file=sys.stderr,
+              flush=True)
+        server.serve_forever()
+        print("pluss serve: drained and stopped", file=sys.stderr)
+        obs.flush_metrics()
+        return 0
 
     spec = REGISTRY[args.model](args.n)
     cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk)

@@ -95,16 +95,14 @@ def _plan_cache_salt() -> str:
     return h.hexdigest()[:16]
 
 
-def _plan_cache_path(key: str) -> str | None:
-    """Disk slot for one nest's plan artifacts, or None when caching is off.
-
-    The cache holds host-side analysis products only (WindowTemplate +
-    verified OverlayPlans) — expensive to build (GEMM-4096's template
-    lexsort is minutes; overlay verification is seconds-to-tens), cheap to
-    load.  Directory: $PLUSS_PLAN_CACHE_DIR, else ``.bench/plan_cache`` if
-    ``.bench`` exists in the CWD (the bench/driver layout); else disabled.
-    ``PLUSS_NO_PLAN_CACHE=1`` disables (the test suite sets it so template
-    bugs can never hide behind a stale artifact)."""
+def _plan_cache_root() -> str | None:
+    """The plan-cache directory, or None when caching is off — the ONE
+    resolution shared by put/get/evict (eviction unlinks files, so the
+    three must agree on the directory by construction).  Directory:
+    $PLUSS_PLAN_CACHE_DIR, else ``.bench/plan_cache`` if ``.bench``
+    exists in the CWD (the bench/driver layout); else disabled.
+    ``PLUSS_NO_PLAN_CACHE=1`` disables (the test suite sets it so
+    template bugs can never hide behind a stale artifact)."""
     if os.environ.get("PLUSS_NO_PLAN_CACHE"):
         return None
     root = os.environ.get("PLUSS_PLAN_CACHE_DIR")
@@ -112,6 +110,19 @@ def _plan_cache_path(key: str) -> str | None:
         if not os.path.isdir(".bench"):
             return None
         root = os.path.join(".bench", "plan_cache")
+    return root
+
+
+def _plan_cache_path(key: str) -> str | None:
+    """Disk slot for one nest's plan artifacts, or None when caching is off.
+
+    The cache holds host-side analysis products only (WindowTemplate +
+    verified OverlayPlans) — expensive to build (GEMM-4096's template
+    lexsort is minutes; overlay verification is seconds-to-tens), cheap to
+    load."""
+    root = _plan_cache_root()
+    if root is None:
+        return None
     os.makedirs(root, exist_ok=True)
     return os.path.join(root, key + ".pkl")
 
@@ -122,6 +133,51 @@ def _plan_cache_key(spec, cfg, ni: int, W: int, NW: int) -> str:
     return hashlib.sha256(
         repr((_plan_cache_salt(), spec, cfg, ni, W, NW)).encode()
     ).hexdigest()[:32]
+
+
+def plan_cache_max() -> int:
+    """Disk plan-cache entry cap (``PLUSS_PLAN_CACHE_MAX``, default 256;
+    0 disables eviction).  A long-lived daemon plans a new (spec, cfg)
+    per novel request forever — without a cap the artifact directory
+    grows unboundedly (nothing else ever removes non-corrupt entries)."""
+    from pluss.utils.envknob import env_int
+
+    return env_int("PLUSS_PLAN_CACHE_MAX", 256, minimum=0)
+
+
+def _plan_cache_evict() -> None:
+    """Evict least-recently-USED entries past :func:`plan_cache_max`.
+
+    Recency is file mtime: :func:`_plan_cache_get` touches an entry on
+    every hit, so a warm daemon's hot plans never age out while one-off
+    requests' artifacts do.  Concurrent writers may race the listing —
+    a missing file mid-evict is someone else's eviction, not an error."""
+    cap = plan_cache_max()
+    if cap <= 0:
+        return
+    root = _plan_cache_root()
+    if root is None:
+        return
+    try:
+        entries = []
+        with os.scandir(root) as it:
+            for de in it:
+                if de.name.endswith(".pkl"):
+                    try:
+                        entries.append((de.stat().st_mtime, de.path))
+                    except OSError:
+                        continue
+    except OSError:
+        return
+    if len(entries) <= cap:
+        return
+    entries.sort()
+    for _, path in entries[: len(entries) - cap]:
+        try:
+            os.unlink(path)
+            obs.counter_add("engine.plan_cache.evict")
+        except OSError:
+            continue
 
 
 def _plan_cache_get(key: str):
@@ -140,6 +196,10 @@ def _plan_cache_get(key: str):
         with open(path, "rb") as f:
             value = pickle.load(f)
         obs.counter_add("engine.plan_cache.hit")
+        try:
+            os.utime(path)   # refresh LRU recency for _plan_cache_evict
+        except OSError:
+            pass
         return value
     except Exception as e:
         # QUARANTINE, don't silently rebuild every run: rename the bad
@@ -169,6 +229,7 @@ def _plan_cache_put(key: str, value) -> None:
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    _plan_cache_evict()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1651,6 +1712,20 @@ class SamplerResult:
     def share_list(self) -> list[dict]:
         return [self.share_dict(t) for t in range(self.thread_num)]
 
+    def tenant_view(self) -> "SamplerResult":
+        """An independently-owned copy for ONE tenant of a coalesced
+        dispatch (pluss.serve): the serving demux hands each member of a
+        shared batch its own view, so no tenant's post-processing (the
+        CRI pass mutates nothing today, but response shaping may grow)
+        can alias another's arrays or dicts.  The copy is cheap —
+        [T, NBINS] ints plus the raw share dicts — next to the dispatch
+        it amortizes."""
+        return dataclasses.replace(
+            self,
+            noshare_dense=self.noshare_dense.copy(),
+            share_raw=[dict(d) for d in self.share_raw],
+        )
+
 
 def add_static_share(share_raw: list[dict],
                      nest_windows: list[tuple[NestPlan, int]]) -> None:
@@ -1768,6 +1843,26 @@ def overlay_static_share(share_raw: list[dict], pl: StreamPlan) -> None:
                 # sweeps zeros and asserts non-negativity at the end
                 for v, c in zip(uv.tolist(), uc.tolist()):
                     d[v] = d.get(v, 0) - c
+
+
+def dispatch_key(spec: LoopNestSpec, cfg: SamplerConfig,
+                 share_cap: int = SHARE_CAP,
+                 window_accesses: int | None = None) -> tuple:
+    """Batch-compatibility key of one prediction request (pluss.serve).
+
+    Two requests with equal keys resolve to the SAME plan — same window /
+    n_windows / cls grid, same compiled executable — so one windowed-
+    engine dispatch can serve all of them, with per-request result views
+    demultiplexed on return (:meth:`SamplerResult.tenant_view`).  The key
+    is exactly the executable memo's identity minus the backend knobs
+    that never change under serving (assignment/start_point pinned to
+    their defaults) and minus ``cache_kb``, which only steers the
+    post-dispatch AET/MRC conversion — requests differing in cache size
+    alone share the dispatch and diverge at demux.  Specs and configs
+    are frozen dataclasses, so the tuple is hashable and order-stable.
+    """
+    return (spec, dataclasses.replace(cfg, cache_kb=0), int(share_cap),
+            window_accesses)
 
 
 def _auto_dispatch(pl: StreamPlan, cfg: SamplerConfig,

@@ -15,6 +15,7 @@ textfile at shutdown.
 from pluss.obs.telemetry import (  # noqa: F401
     NOOP_SPAN,
     SCHEMA_VERSION,
+    LatencyReservoir,
     Telemetry,
     active,
     configure,

@@ -11,6 +11,10 @@ extras: concurrent wire-encode seconds across the worker pool and the
 wire-vs-device byte ratio (how much the compressed d24v wire shaved off
 the transport).
 
+When the serving counters are present (a ``pluss serve`` daemon's
+stream), a "serve SLO" block renders request outcomes, p50/p99 latency,
+batch occupancy, queue pressure, and the per-request ladder activity.
+
 ``--check`` validates the stream against the schema instead (exit 1 on
 any violation).  A torn FINAL line is tolerated with a notice — that is
 the expected crash artifact of the sink's append discipline; torn or
@@ -251,6 +255,62 @@ def trace_breakdown(counters: dict[str, float],
     return lines
 
 
+def serve_breakdown(counters: dict[str, float],
+                    gauges: dict[str, float]) -> list[str]:
+    """The serving SLO block: request outcomes, latency quantiles, batch
+    occupancy (how many requests each device dispatch served), queue
+    pressure, and the per-request resilience activity.  Empty when the
+    serve counters are absent from the stream."""
+    total = counters.get("serve.requests")
+    if not total:
+        return []
+    lines = ["serve SLO:"]
+    kinds = [f"{k[len('serve.requests.'):]} {int(v)}"
+             for k, v in sorted(counters.items())
+             if k.startswith("serve.requests.")]
+    lines.append(f"  {'requests':<28} {int(total):>9}"
+                 + (f"  ({', '.join(kinds)})" if kinds else ""))
+    for label, key in (("ok", "serve.ok"),
+                       ("errors", "serve.errors"),
+                       ("shed (admission)", "serve.shed"),
+                       ("deadline exceeded", "serve.deadline_exceeded"),
+                       ("admission rejects", "serve.admission_rejects")):
+        v = counters.get(key)
+        if v:
+            pct = 100.0 * v / total
+            lines.append(f"  {label:<28} {int(v):>9}  ({pct:.1f}%)")
+    p50, p99 = gauges.get("serve.p50_ms"), gauges.get("serve.p99_ms")
+    if p50 is not None or p99 is not None:
+        lines.append(
+            f"  {'latency p50 / p99':<28} "
+            f"{_fmt_val(p50) if p50 is not None else '?':>9} / "
+            f"{_fmt_val(p99) if p99 is not None else '?'} ms")
+    batches = counters.get("serve.batches")
+    if batches:
+        members = counters.get("serve.batched_requests", 0.0)
+        lines.append(
+            f"  {'batches dispatched':<28} {int(batches):>9}  "
+            f"(occupancy {members / batches:.2f} req/dispatch, "
+            f"{int(members - batches)} dispatch(es) coalesced away)")
+    qd = gauges.get("serve.queue_depth")
+    if qd is not None:
+        lines.append(f"  {'queue depth (last)':<28} {_fmt_val(qd):>9}")
+    rungs = counters.get("resilience.rungs_taken")
+    if rungs:
+        per = [f"{k[len('resilience.rungs_taken.'):]}={int(v)}"
+               for k, v in sorted(counters.items())
+               if k.startswith("resilience.rungs_taken.")]
+        lines.append(f"  {'ladder rungs taken':<28} {int(rungs):>9}"
+                     + (f"  ({', '.join(per)})" if per else ""))
+    hits = counters.get("engine.plan_cache.hit")
+    if hits is not None or counters.get("engine.plan_cache.miss"):
+        miss = counters.get("engine.plan_cache.miss", 0.0)
+        ev = counters.get("engine.plan_cache.evict", 0.0)
+        lines.append(f"  {'plan cache hit/miss/evict':<28} "
+                     f"{int(hits or 0):>9} / {int(miss)} / {int(ev)}")
+    return lines
+
+
 def render(records: list[dict], out) -> None:
     """Write the human report for one loaded stream."""
     n_spans = sum(1 for r in records if r.get("ev") == "span")
@@ -288,6 +348,9 @@ def render(records: list[dict], out) -> None:
     block = trace_breakdown(counters, wall)
     if block:
         out.write("\n".join(block) + "\n")
+    sblock = serve_breakdown(counters, gauges)
+    if sblock:
+        out.write("\n".join(sblock) + "\n")
 
 
 def main(path: str, out, err, check: bool = False) -> int:

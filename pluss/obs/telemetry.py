@@ -275,6 +275,54 @@ class Telemetry:
                       file=sys.stderr)
 
 
+class LatencyReservoir:
+    """Thread-safe sliding window of the most recent ``capacity`` samples
+    with quantile reads — the SLO substrate of the serving layer (p50/p99
+    request latency published as gauges).
+
+    A plain ring, not a sketch: at serving rates the window is a few
+    thousand floats, and exact quantiles over "the recent past" are what
+    an operator actually wants from a gauge.  ``add`` is O(1) under one
+    lock; ``quantile`` sorts a snapshot (O(n log n) but only on publish,
+    which the server throttles)."""
+
+    __slots__ = ("_cap", "_ring", "_n", "_lock")
+
+    def __init__(self, capacity: int = 2048):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._cap = capacity
+        self._ring: list[float] = []
+        self._n = 0          # total samples ever added
+        self._lock = threading.Lock()
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            if len(self._ring) < self._cap:
+                self._ring.append(v)
+            else:
+                self._ring[self._n % self._cap] = v
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def quantile(self, q: float) -> float | None:
+        """The ``q``-quantile (0..1, nearest-rank) of the current window,
+        or None when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            snap = list(self._ring)
+        if not snap:
+            return None
+        snap.sort()
+        return snap[min(len(snap) - 1, int(q * len(snap)))]
+
+
 def _prom_name(name: str) -> str:
     out = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
     if not out or not (out[0].isalpha() or out[0] == "_"):

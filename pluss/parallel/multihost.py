@@ -294,6 +294,58 @@ def dead_workers(hb_dir: str, num_processes: int,
     return dead
 
 
+def start_heartbeat_exporter(hb_dir: str, num_processes: int,
+                             interval_s: float | None = None):
+    """Long-poll exporter: refresh the ``multihost.heartbeat_age_s.<i>``
+    gauges (via :func:`dead_workers`) AND rewrite the Prometheus textfile
+    on a timer, so fleet health is scrapeable from a RUNNING daemon — the
+    shutdown-time-only export left a long-lived ``pluss serve`` process
+    invisible to a scraper for its whole life (recorded PR-5 follow-up).
+
+    ``interval_s`` defaults to ``PLUSS_PROM_REFRESH_S`` (5 s, floored at
+    the heartbeat interval — refreshing faster than beats arrive only
+    re-publishes the same ages).  The textfile rewrite needs a configured
+    ``PLUSS_PROM`` path; without one the timer still refreshes the gauges
+    into the event stream.  Returns a ``stop()`` callable (idempotent,
+    joins the thread); the thread is a daemon, so a forgotten stop never
+    blocks process exit.  Failures inside one tick are swallowed after a
+    one-line notice — an exporter must never take down the daemon it
+    observes."""
+    if interval_s is None:
+        interval_s = envknob.env_float("PLUSS_PROM_REFRESH_S", 5.0, 0.1)
+    interval_s = max(interval_s, heartbeat_interval_s())
+    stop_ev = threading.Event()
+    warned = [False]
+
+    def tick() -> None:
+        try:
+            dead_workers(hb_dir, num_processes)
+            tel = obs.active()
+            if tel is not None and tel.prom_path:
+                tel.write_prom()
+        except Exception as e:  # noqa: BLE001 — observer must not kill host
+            if not warned[0]:
+                warned[0] = True
+                import sys
+
+                print(f"multihost: heartbeat exporter tick failed ({e}); "
+                      "continuing", file=sys.stderr)
+
+    def loop() -> None:
+        while not stop_ev.wait(interval_s):
+            tick()
+
+    t = threading.Thread(target=loop, name="pluss-hb-exporter", daemon=True)
+    t.start()
+
+    def stop() -> None:
+        stop_ev.set()
+        t.join(timeout=5)
+        tick()   # one final refresh so the textfile reflects shutdown state
+
+    return stop
+
+
 def watched_shard_run(spec, cfg=None, share_cap: int | None = None,
                       mesh: Mesh | None = None, *,
                       hb_dir: str, num_processes: int | None = None,

@@ -35,6 +35,9 @@ from pluss.resilience.errors import (
     CollectiveError,
     CompileError,
     DataLoss,
+    DeadlineExceeded,
+    InvalidRequest,
+    Overloaded,
     PlussError,
     ResourceExhausted,
     ShareCapOverflow,
@@ -45,6 +48,7 @@ from pluss.resilience.faults import FaultPlan
 from pluss.resilience.journal import Journal
 from pluss.resilience.ladder import (
     LADDER,
+    SERVE_LADDER,
     Retry,
     replay_file_resilient,
     run_resilient,
@@ -52,7 +56,8 @@ from pluss.resilience.ladder import (
 
 __all__ = [
     "PlussError", "ResourceExhausted", "CompileError", "ShareCapOverflow",
-    "CollectiveError", "WorkerDied", "DataLoss", "CacheCorrupt", "classify",
-    "FaultPlan", "Journal", "LADDER", "Retry", "run_resilient",
-    "replay_file_resilient",
+    "CollectiveError", "WorkerDied", "DataLoss", "CacheCorrupt",
+    "Overloaded", "DeadlineExceeded", "InvalidRequest", "classify",
+    "FaultPlan", "Journal", "LADDER", "SERVE_LADDER", "Retry",
+    "run_resilient", "replay_file_resilient",
 ]

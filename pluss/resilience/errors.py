@@ -121,6 +121,36 @@ class CacheCorrupt(PlussError):
     retryable = True
 
 
+class Overloaded(PlussError):
+    """The serving admission bound is full: the request was SHED before
+    any work happened (``pluss.serve.admission``).  Retryable — from the
+    *client's* side, after backing off; the server itself never retries a
+    shed request (that would amplify the overload it protects against)."""
+
+    retryable = True
+
+
+class DeadlineExceeded(PlussError):
+    """A request's deadline passed before (or while) producing its result.
+    Fatal for the attempt — retrying a dead request would burn capacity on
+    an answer nobody is waiting for; the caller decides whether to re-ask
+    with a fresh deadline."""
+
+
+class InvalidRequest(PlussError):
+    """A serving request failed admission: unparseable JSON, a spec the
+    PR-1/PR-3 analyzers reject with ERROR diagnostics, an unknown model,
+    or a stream past the per-request size bound.  Fatal — the input
+    itself is wrong; ``diagnostics`` carries the analyzer findings (as
+    plain dicts) when the rejection came from the static analyzers."""
+
+    def __init__(self, message: str, site: str = "",
+                 cause: BaseException | None = None,
+                 diagnostics: tuple = ()):
+        super().__init__(message, site, cause)
+        self.diagnostics = diagnostics
+
+
 #: substring markers of XLA out-of-memory errors (jaxlib surfaces them as
 #: ``XlaRuntimeError`` whose str starts with the status code)
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",

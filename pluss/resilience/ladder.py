@@ -43,6 +43,7 @@ import sys
 import time
 
 from pluss.resilience.errors import (
+    DeadlineExceeded,
     PlussError,
     ShareCapOverflow,
     classify,
@@ -64,6 +65,14 @@ SHARD_LADDER: tuple[str, ...] = ("shrink_window", "single_device",
 #: window, then leave the accelerator
 TRACE_LADDER: tuple[str, ...] = ("serial_feed", "shrink_window",
                                  "cpu_fallback")
+
+#: ladder of a MULTI-TENANT serving request (pluss.serve): same shape as
+#: the default ladder MINUS ``cpu_fallback`` — force_cpu pins the whole
+#: PROCESS to the CPU platform, so one degraded request would silently
+#: degrade every later tenant's request.  A request that exhausts these
+#: rungs fails classified instead; the process stays healthy.
+SERVE_LADDER: tuple[str, ...] = ("shrink_window", "raise_n_windows",
+                                 "sliced_pipeline")
 
 
 @dataclasses.dataclass
@@ -111,17 +120,30 @@ def _next_share_cap(err: ShareCapOverflow, share_cap: int) -> int:
 
 
 def _resilient_loop(make_attempt, apply_rung, rungs: tuple[str, ...],
-                    retry: Retry, label: str):
+                    retry: Retry, label: str,
+                    deadline: float | None = None):
     """Shared control flow: returns (result, degradations tuple).
 
     ``make_attempt(state)`` runs one attempt from the mutable state dict;
     ``apply_rung(state, rung)`` mutates state for a degradation rung.
+    ``deadline``: optional ``time.monotonic()`` instant after which the
+    loop stops RE-ATTEMPTING (raising :class:`DeadlineExceeded`) — a
+    running attempt is never interrupted (device dispatches cannot be
+    safely cancelled mid-flight), so the deadline bounds retry/degrade
+    churn, not the first attempt's own wall time.  The serving layer
+    enforces the response-side deadline separately at demux.
     """
     degradations: list[str] = []
     rung_idx = 0
     retries = 0
     state: dict = {}
     while True:
+        if deadline is not None and time.monotonic() > deadline:
+            raise DeadlineExceeded(
+                f"deadline passed after {retries} attempt(s)"
+                + (f" (degradations: {','.join(degradations)})"
+                   if degradations else ""),
+                site=label)
         try:
             return make_attempt(state), tuple(degradations)
         except BaseException as e:  # noqa: BLE001 — classify funnels all
@@ -166,19 +188,28 @@ def _resilient_loop(make_attempt, apply_rung, rungs: tuple[str, ...],
 def run_resilient(spec, cfg=None, share_cap: int | None = None, *,
                   backend: str = "vmap", assignment=None, start_point=None,
                   window_accesses: int | None = None, mesh=None,
-                  retry: Retry | None = None):
+                  retry: Retry | None = None,
+                  rungs: tuple[str, ...] | None = None,
+                  deadline_s: float | None = None):
     """Degradation-ladder wrapper of ``engine.run`` / ``shard.shard_run``.
 
     Same signature surface as the wrapped runners; returns the same
     :class:`~pluss.engine.SamplerResult`, with ``degradations`` stamped
     (empty tuple for a clean first-attempt run).  Raises only
     :class:`~pluss.resilience.errors.PlussError` subclasses.
+
+    ``rungs`` overrides the ladder (the serving layer passes
+    :data:`SERVE_LADDER`, which bans the process-pinning ``cpu_fallback``
+    rung); ``deadline_s`` bounds the retry/degrade churn from NOW — past
+    it the loop raises :class:`DeadlineExceeded` instead of re-attempting
+    (a running attempt is never interrupted).
     """
     from pluss.config import DEFAULT, SHARE_CAP
 
     cfg = cfg if cfg is not None else DEFAULT
     retry = retry or Retry()
-    rungs = SHARD_LADDER if backend == "shard" else LADDER
+    if rungs is None:
+        rungs = SHARD_LADDER if backend == "shard" else LADDER
 
     def make_attempt(state: dict):
         from pluss import engine
@@ -226,17 +257,23 @@ def run_resilient(spec, cfg=None, share_cap: int | None = None, *,
 
     res, degradations = _resilient_loop(
         make_attempt, apply_rung, rungs, retry,
-        label=f"run[{spec.name}]")
+        label=f"run[{spec.name}]",
+        deadline=(time.monotonic() + deadline_s
+                  if deadline_s is not None else None))
     res.degradations = _stamp(degradations)
     return res
 
 
 def replay_file_resilient(path: str, fmt: str = "u64", *,
-                          retry: Retry | None = None, **kw):
+                          retry: Retry | None = None,
+                          rungs: tuple[str, ...] | None = None, **kw):
     """Degradation-ladder wrapper of ``trace.replay_file`` (and the
     checkpointed variant when ``checkpoint_path``/``resume`` are passed
-    through ``kw``).  Stamps ``degradations`` on the ReplayResult."""
+    through ``kw``).  Stamps ``degradations`` on the ReplayResult.
+    ``rungs`` overrides :data:`TRACE_LADDER` (the serving layer passes a
+    subset without the process-pinning ``cpu_fallback``)."""
     retry = retry or Retry()
+    rungs = TRACE_LADDER if rungs is None else rungs
     ckpt = bool(kw.get("checkpoint_path"))
     if ckpt and kw.get("wire") in (None, "auto"):
         # the wire joins the checkpoint identity: pin the auto-resolution
@@ -290,7 +327,7 @@ def replay_file_resilient(path: str, fmt: str = "u64", *,
             raise AssertionError(f"unknown rung {rung}")
 
     res, degradations = _resilient_loop(
-        make_attempt, apply_rung, TRACE_LADDER, retry,
+        make_attempt, apply_rung, rungs, retry,
         label=f"trace[{path}]")
     res.degradations = _stamp(degradations)
     return res

@@ -1,0 +1,36 @@
+"""pluss.serve — the long-lived multi-tenant MRC prediction service.
+
+PLUSS predicts miss-ratio curves *without running the program*, which
+makes it a natural online service: callers submit a loop nest (registry
+model or inline spec) or a packed trace over a JSONL socket and get an
+MRC back, amortizing compiled plans across millions of requests.  The
+pieces:
+
+- :mod:`pluss.serve.protocol`  — request/response schema, the inline-spec
+  codec, the analyzer admission gate, and a small client;
+- :mod:`pluss.serve.admission` — the bounded shed-don't-block queue;
+- :mod:`pluss.serve.batcher`   — shared-dispatch coalescing of
+  plan-compatible requests (max-delay/max-batch adaptive window);
+- :mod:`pluss.serve.server`    — the daemon: listener, device loop,
+  per-request resilience ladder, SLO gauges, drain-and-stop.
+
+Start one with ``pluss serve --socket /tmp/pluss.sock`` (or ``--port``),
+load it with ``python soak.py --serve N``, and read its SLOs with
+``pluss stats <telemetry.jsonl>``.
+"""
+
+from pluss.serve.admission import AdmissionQueue  # noqa: F401
+from pluss.serve.batcher import Batcher  # noqa: F401
+from pluss.serve.protocol import (  # noqa: F401
+    Client,
+    Request,
+    parse_request,
+    spec_from_json,
+    spec_to_json,
+)
+from pluss.serve.server import ServeConfig, Server  # noqa: F401
+
+__all__ = [
+    "AdmissionQueue", "Batcher", "Client", "Request", "parse_request",
+    "spec_from_json", "spec_to_json", "ServeConfig", "Server",
+]

@@ -1,0 +1,150 @@
+"""Admission control: a bounded request queue that SHEDS, never blocks.
+
+The serving failure mode this module exists for: under overload an
+unbounded queue converts every request into a slow request (everyone
+waits behind everyone), while a blocking bounded queue converts the
+ACCEPT path into the bottleneck (connection handlers wedge, clients see
+silence).  The correct shape — the one every production admission layer
+converges on — is a bounded FIFO whose ``submit`` fails FAST with a
+typed :class:`~pluss.resilience.errors.Overloaded` the client can key
+backoff on, so the deepest a request can ever queue is ``max_queue``
+dispatches' worth of work.
+
+The queue also owns deadline hygiene on the way OUT: ``pop`` lazily
+drops requests that expired while queued (returning them separately so
+the server can answer each with a typed ``DeadlineExceeded`` — a shed
+response beats a mystery timeout), and ``take_matching`` lets the
+batcher coalesce compatible requests from ANYWHERE in the queue onto one
+dispatch — batching is the one sanctioned FIFO violation, bounded by the
+batcher's ``max_batch``.
+
+Queue depth is published as the ``serve.queue_depth`` gauge on every
+transition; sheds count under ``serve.shed``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from pluss import obs
+from pluss.resilience.errors import Overloaded
+from pluss.serve.protocol import Request
+
+
+class AdmissionQueue:
+    """Bounded FIFO of admitted requests (thread-safe)."""
+
+    def __init__(self, max_queue: int = 128):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self._dq: collections.deque[Request] = collections.deque()
+        self._cv = threading.Condition()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._dq)
+
+    def _gauge(self) -> None:
+        obs.gauge_set("serve.queue_depth", float(len(self._dq)))
+
+    def close(self) -> None:
+        """Stop admitting; queued requests stay poppable (drain)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def submit(self, req: Request) -> None:
+        """Enqueue or shed.  Raises :class:`Overloaded` when the bound is
+        reached or the queue is draining — the caller answers the client
+        with the typed error; nothing ever blocks here."""
+        with self._cv:
+            if self._closed:
+                obs.counter_add("serve.shed")
+                raise Overloaded("server is draining; not admitting",
+                                 site="serve.admission")
+            if len(self._dq) >= self.max_queue:
+                obs.counter_add("serve.shed")
+                raise Overloaded(
+                    f"admission queue full ({self.max_queue} deep); "
+                    "back off and retry", site="serve.admission")
+            self._dq.append(req)
+            self._gauge()
+            self._cv.notify()
+
+    def pop(self, timeout: float | None = None
+            ) -> tuple[Request | None, list[Request]]:
+        """``(head, expired)``: the first still-live request (None on
+        timeout / empty-and-closed), plus any requests that expired while
+        queued — the caller owes each of those a ``DeadlineExceeded``
+        response."""
+        expired: list[Request] = []
+        with self._cv:
+            while True:
+                while self._dq:
+                    req = self._dq.popleft()
+                    if req.expired():
+                        expired.append(req)
+                        continue
+                    self._gauge()
+                    return req, expired
+                # gauge only on actual depth TRANSITIONS: an idle daemon's
+                # 4 Hz poll timeout must not append an identical record to
+                # the stream every 250 ms for its whole (long) life — the
+                # same record-flood class as the PR-5 heartbeat throttle
+                if self._closed:
+                    if expired:
+                        self._gauge()
+                    return None, expired
+                if not self._cv.wait(timeout):
+                    if expired:
+                        self._gauge()
+                    return None, expired
+
+    def take_matching(self, key: tuple,
+                      limit: int) -> tuple[list[Request], list[Request]]:
+        """``(matches, expired)``: remove up to ``limit`` queued requests
+        whose batch key equals ``key`` (scanning the whole queue:
+        coalescing may jump the FIFO — that is the point of batching).
+        Expired MATCHING requests are drained too (second list; the
+        caller owes each a ``DeadlineExceeded``) — leaving them queued
+        would make the batcher's linger loop spin on a queue that looks
+        non-empty but never yields a member."""
+        if limit <= 0:
+            return [], []
+        out: list[Request] = []
+        expired: list[Request] = []
+        with self._cv:
+            kept: collections.deque[Request] = collections.deque()
+            while self._dq and len(out) < limit:
+                req = self._dq.popleft()
+                if req.batch_key() != key:
+                    kept.append(req)
+                elif req.expired():
+                    expired.append(req)
+                else:
+                    out.append(req)
+            kept.extend(self._dq)
+            self._dq = kept
+            if out or expired:
+                self._gauge()
+        return out, expired
+
+    def wait_for_arrival(self, timeout: float) -> bool:
+        """Block until something (anything) is queued, up to ``timeout``.
+        The batcher's adaptive delay uses this to sleep exactly until a
+        coalescing candidate COULD exist instead of polling."""
+        with self._cv:
+            if self._dq:
+                return True
+            self._cv.wait(timeout)
+            return bool(self._dq)
+
+    def has_other_work(self, key: tuple) -> bool:
+        """Whether a NON-matching request is queued — the adaptive batch
+        window closes early when holding the dispatch would add latency
+        to somebody else's unrelated work."""
+        with self._cv:
+            return any(r.batch_key() != key for r in self._dq)

@@ -1,0 +1,538 @@
+"""The serving wire protocol: JSONL requests/responses + the admission gate.
+
+One request = one JSON object = one line.  Three request shapes share the
+schema (exactly one selector per request):
+
+- ``{"model": "gemm", "n": 64, ...}`` — a registry model at a size;
+- ``{"spec": {...}, ...}`` — an inline :class:`~pluss.spec.LoopNestSpec`
+  (see :func:`spec_from_json`; :func:`spec_to_json` is its inverse);
+- ``{"trace": "/path/refs.bin", "fmt": "u64", ...}`` — a packed-trace
+  replay (a SERVER-side path: the daemon serves local callers, it is not
+  an internet-facing file service).
+
+Common fields: ``id`` (echoed; assigned when absent), schedule knobs
+(``threads``/``chunk``/``ds``/``cls``), ``window``, ``share_cap``,
+``output`` (``mrc`` | ``histogram`` | ``both``), ``deadline_ms`` (from
+admission), ``verify`` (opt into the full schedule-aware PR-3 analysis on
+top of the always-on PR-1 lint gate), and ``sleep_ms`` (a documented
+load-generator knob that holds the device loop — how the soak harness
+makes sheds and queue pressure deterministic).
+
+Responses echo ``id`` with ``ok: true`` plus the result payload, or
+``ok: false`` with a typed ``error`` object mirroring the resilience
+taxonomy (``Overloaded``, ``DeadlineExceeded``, ``InvalidRequest``, …)
+so clients can key backoff/retry policy on ``error.type`` +
+``error.retryable``, never on message text.
+
+The ADMISSION GATE lives here (:func:`parse_request`): spec requests are
+validated through the PR-1 static analyzer (ERROR diagnostics reject the
+request with the findings attached) and bounded by
+``PLUSS_SERVE_MAX_REFS`` before any device work is scheduled; verdicts
+are memoized per spec so a hot model lints once, not per request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+import json
+import socket
+import time
+
+from pluss.config import SHARE_CAP, SamplerConfig
+from pluss.resilience.errors import InvalidRequest, PlussError
+from pluss.spec import Loop, LoopNestSpec, Ref, SpecContractError, loop_size
+
+#: default per-request stream bound (total accesses across threads): big
+#: enough for the flagship gemm-1024 (4.3e9), small enough that one rogue
+#: inline spec cannot wedge the shared device loop for hours
+MAX_REFS_DEFAULT = 1 << 34
+
+_anon_ids = itertools.count(1)
+
+
+def max_serve_refs() -> int:
+    from pluss.utils.envknob import env_int
+
+    return env_int("PLUSS_SERVE_MAX_REFS", MAX_REFS_DEFAULT)
+
+
+# ---------------------------------------------------------------------------
+# inline spec codec
+
+
+def spec_to_json(spec: LoopNestSpec) -> dict:
+    """JSON-able dict encoding of a spec (inverse of :func:`spec_from_json`)."""
+
+    def enc_item(item):
+        if isinstance(item, Ref):
+            d = {"name": item.name, "array": item.array,
+                 "addr_terms": [list(t) for t in item.addr_terms]}
+            if item.addr_base:
+                d["addr_base"] = item.addr_base
+            if item.share_span is not None:
+                d["share_span"] = item.share_span
+            if item.is_write:
+                d["is_write"] = True
+            if item.dtype_bytes is not None:
+                d["dtype_bytes"] = item.dtype_bytes
+            return d
+        d = {"trip": item.trip, "body": [enc_item(b) for b in item.body]}
+        if item.start:
+            d["start"] = item.start
+        if item.step != 1:
+            d["step"] = item.step
+        if item.bound_coef is not None:
+            d["bound_coef"] = list(item.bound_coef)
+        if item.start_coef:
+            d["start_coef"] = item.start_coef
+        if item.bound_level:
+            d["bound_level"] = item.bound_level
+        return d
+
+    return {"name": spec.name,
+            "arrays": [[a, n] for a, n in spec.arrays],
+            "nests": [enc_item(n) for n in spec.nests]}
+
+
+def _as_int(obj, key: str, default=None, where: str = "spec"):
+    v = obj.get(key, default)
+    if v is None:
+        if default is None:
+            raise InvalidRequest(f"{where}: missing required field "
+                                 f"{key!r}", site="serve.parse")
+        v = default   # explicit null means "use the default"
+    if isinstance(v, bool) or not isinstance(v, int):
+        raise InvalidRequest(f"{where}: field {key!r} must be an integer, "
+                             f"got {v!r}", site="serve.parse")
+    return v
+
+
+def spec_from_json(obj) -> LoopNestSpec:
+    """Decode an inline spec; every malformation raises
+    :class:`InvalidRequest` (never a KeyError/TypeError leaking schema
+    internals to the connection handler)."""
+    if not isinstance(obj, dict):
+        raise InvalidRequest(f"spec must be an object, got "
+                             f"{type(obj).__name__}", site="serve.parse")
+
+    def dec_item(d, where: str):
+        if not isinstance(d, dict):
+            raise InvalidRequest(f"{where}: body item must be an object",
+                                 site="serve.parse")
+        if "array" in d:    # a Ref
+            name = d.get("name")
+            arr = d.get("array")
+            terms = d.get("addr_terms")
+            if not isinstance(name, str) or not isinstance(arr, str):
+                raise InvalidRequest(f"{where}: ref needs string 'name' "
+                                     "and 'array'", site="serve.parse")
+            if not isinstance(terms, list) or not all(
+                    isinstance(t, list) and len(t) == 2
+                    and all(isinstance(x, int) and not isinstance(x, bool)
+                            for x in t) for t in terms):
+                raise InvalidRequest(
+                    f"{where}: ref {name!r} needs addr_terms as a list of "
+                    "[depth, coef] integer pairs", site="serve.parse")
+            span = d.get("share_span")
+            dtb = d.get("dtype_bytes")
+            for fld, v in (("share_span", span), ("dtype_bytes", dtb)):
+                if v is not None and (isinstance(v, bool)
+                                      or not isinstance(v, int)):
+                    raise InvalidRequest(f"{where}: ref {name!r} field "
+                                         f"{fld!r} must be an integer or "
+                                         "null", site="serve.parse")
+            return Ref(name=name, array=arr,
+                       addr_terms=tuple((t[0], t[1]) for t in terms),
+                       addr_base=_as_int(d, "addr_base", 0, where),
+                       share_span=span,
+                       is_write=bool(d.get("is_write", False)),
+                       dtype_bytes=dtb)
+        if "body" in d:     # a Loop
+            body = d.get("body")
+            if not isinstance(body, list) or not body:
+                raise InvalidRequest(f"{where}: loop needs a non-empty "
+                                     "'body' list", site="serve.parse")
+            bc = d.get("bound_coef")
+            if bc is not None and not (
+                    isinstance(bc, list) and len(bc) == 2
+                    and all(isinstance(x, int) and not isinstance(x, bool)
+                            for x in bc)):
+                raise InvalidRequest(f"{where}: bound_coef must be an "
+                                     "[a, b] integer pair or null",
+                                     site="serve.parse")
+            return Loop(trip=_as_int(d, "trip", None, where),
+                        body=tuple(dec_item(b, where + ".body")
+                                   for b in body),
+                        start=_as_int(d, "start", 0, where),
+                        step=_as_int(d, "step", 1, where),
+                        bound_coef=tuple(bc) if bc is not None else None,
+                        start_coef=_as_int(d, "start_coef", 0, where),
+                        bound_level=_as_int(d, "bound_level", 0, where))
+        raise InvalidRequest(f"{where}: item is neither a ref (has "
+                             "'array') nor a loop (has 'body')",
+                             site="serve.parse")
+
+    name = obj.get("name")
+    if not isinstance(name, str) or not name:
+        raise InvalidRequest("spec needs a non-empty string 'name'",
+                             site="serve.parse")
+    arrays = obj.get("arrays")
+    if not isinstance(arrays, list) or not all(
+            isinstance(a, list) and len(a) == 2 and isinstance(a[0], str)
+            and isinstance(a[1], int) and not isinstance(a[1], bool)
+            and a[1] > 0 for a in arrays):
+        raise InvalidRequest("spec 'arrays' must be a list of "
+                             "[name, elements>0] pairs", site="serve.parse")
+    nests = obj.get("nests")
+    if not isinstance(nests, list) or not nests:
+        raise InvalidRequest("spec needs a non-empty 'nests' list",
+                             site="serve.parse")
+    return LoopNestSpec(
+        name=name,
+        arrays=tuple((a, n) for a, n in arrays),
+        nests=tuple(dec_item(n, f"nests[{i}]")
+                    for i, n in enumerate(nests)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# requests
+
+
+@dataclasses.dataclass
+class Request:
+    """One parsed, ADMITTED request plus its serving bookkeeping."""
+
+    id: str
+    kind: str                     # "spec" | "trace" | "sleep"
+    cfg: SamplerConfig
+    spec: LoopNestSpec | None = None
+    trace: str | None = None
+    fmt: str = "u64"
+    share_cap: int = SHARE_CAP
+    window: int | None = None
+    output: str = "mrc"
+    sleep_ms: float = 0.0
+    #: absolute monotonic deadline (set at admission), None = no deadline
+    deadline: float | None = None
+    #: monotonic admission instant (latency measurements)
+    t_admit: float = 0.0
+    #: response writer installed by the connection handler:
+    #: ``reply(dict)`` — must be safe to call from the device loop
+    reply: object = None
+
+    def remaining_s(self) -> float | None:
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def expired(self) -> bool:
+        r = self.remaining_s()
+        return r is not None and r <= 0
+
+    def batch_key(self) -> tuple:
+        """Shared-dispatch compatibility key: requests with equal keys are
+        satisfiable by ONE device dispatch (same plan, same compiled
+        shape — see :func:`pluss.engine.dispatch_key`), with per-request
+        views demultiplexed on return.  ``output``/``deadline``/``id``
+        are deliberately absent — response shaping is demux work, not
+        dispatch work.  Sleep requests never coalesce (each holds the
+        loop on purpose)."""
+        if self.kind == "spec":
+            from pluss import engine
+
+            return ("spec",) + engine.dispatch_key(
+                self.spec, self.cfg, self.share_cap, self.window)
+        if self.kind == "trace":
+            return ("trace", self.trace, self.fmt, self.cfg.cls,
+                    self.window)
+        return ("sleep", self.id)
+
+
+@functools.lru_cache(maxsize=256)
+def _lint_verdict(spec: LoopNestSpec) -> tuple:
+    """Memoized PR-1 admission verdict: () for clean, else the ERROR
+    diagnostics as JSON-able dicts.  Hot models lint once, not per
+    request."""
+    from pluss import analysis
+
+    diags = analysis.lint_spec(spec)
+    errs = [d for d in diags if d.severity is analysis.Severity.ERROR]
+    return tuple(
+        {"code": d.code, "severity": "ERROR", "message": d.message}
+        for d in errs
+    )
+
+
+@functools.lru_cache(maxsize=128)
+def _analyze_verdict(spec: LoopNestSpec, cfg: SamplerConfig) -> tuple:
+    """Memoized PR-3 (schedule-aware) verdict for ``verify: true``
+    requests — placement-refined races + false sharing under the
+    request's own schedule."""
+    from pluss import analysis
+
+    diags, _ = analysis.analyze_spec(spec, cfg)
+    errs = [d for d in diags if d.severity is analysis.Severity.ERROR]
+    return tuple(
+        {"code": d.code, "severity": "ERROR", "message": d.message}
+        for d in errs
+    )
+
+
+def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
+    """Parse + ADMIT one request object; raises :class:`InvalidRequest`
+    on any malformation, unknown model, analyzer rejection, or size
+    bound.  On success the request is stamped with its admission instant
+    and absolute deadline."""
+    if not isinstance(obj, dict):
+        raise InvalidRequest(
+            f"request must be a JSON object, got {type(obj).__name__}",
+            site="serve.parse")
+    rid = obj.get("id")
+    if rid is None:
+        rid = f"anon-{next(_anon_ids)}"
+    rid = str(rid)
+
+    selectors = [k for k in ("model", "spec", "trace") if obj.get(k)
+                 is not None]
+    if "sleep_ms" in obj and not selectors:
+        selectors = ["sleep"]
+    if len(selectors) != 1:
+        raise InvalidRequest(
+            f"request {rid!r} must name exactly one of model/spec/trace "
+            f"(got {selectors or 'none'})", site="serve.parse")
+
+    def opt_int(key: str, default, minimum: int = 1):
+        v = obj.get(key)
+        if v is None:
+            return default
+        if isinstance(v, bool) or not isinstance(v, int) or v < minimum:
+            raise InvalidRequest(
+                f"request {rid!r}: {key!r} must be an integer >= "
+                f"{minimum}, got {v!r}", site="serve.parse")
+        return v
+
+    cfg = SamplerConfig(thread_num=opt_int("threads", 4),
+                        chunk_size=opt_int("chunk", 4),
+                        ds=opt_int("ds", 8),
+                        cls=opt_int("cls", 64),
+                        cache_kb=opt_int("cache_kb", 2560))
+    output = obj.get("output", "mrc")
+    if output not in ("mrc", "histogram", "both"):
+        raise InvalidRequest(
+            f"request {rid!r}: output must be mrc|histogram|both, got "
+            f"{output!r}", site="serve.parse")
+    dl_ms = obj.get("deadline_ms", default_deadline_ms)
+    if dl_ms is not None and (isinstance(dl_ms, bool) or not isinstance(
+            dl_ms, (int, float)) or dl_ms <= 0):
+        raise InvalidRequest(
+            f"request {rid!r}: deadline_ms must be a positive number",
+            site="serve.parse")
+    now = time.monotonic()
+    req = Request(
+        id=rid,
+        kind="sleep" if selectors == ["sleep"] else
+             ("trace" if selectors == ["trace"] else "spec"),
+        cfg=cfg,
+        share_cap=opt_int("share_cap", SHARE_CAP),
+        window=opt_int("window", None),
+        output=output,
+        deadline=(now + dl_ms / 1e3) if dl_ms is not None else None,
+        t_admit=now,
+    )
+    if req.kind == "sleep":
+        ms = obj.get("sleep_ms")
+        if isinstance(ms, bool) or not isinstance(ms, (int, float)) \
+                or ms < 0 or ms > 60_000:
+            raise InvalidRequest(
+                f"request {rid!r}: sleep_ms must be in [0, 60000]",
+                site="serve.parse")
+        req.sleep_ms = float(ms)
+        return req
+    if req.kind == "trace":
+        path = obj.get("trace")
+        fmt = obj.get("fmt", "u64")
+        if not isinstance(path, str) or not path:
+            raise InvalidRequest(f"request {rid!r}: trace must be a path",
+                                 site="serve.parse")
+        if fmt not in ("u64", "text"):
+            raise InvalidRequest(
+                f"request {rid!r}: fmt must be u64|text, got {fmt!r}",
+                site="serve.parse")
+        import os
+
+        if not os.path.exists(path):
+            raise InvalidRequest(
+                f"request {rid!r}: no such trace file: {path}",
+                site="serve.parse")
+        req.trace, req.fmt = path, fmt
+        return req
+    # spec request: registry model or inline spec, then the analyzer gate
+    if obj.get("model") is not None:
+        from pluss.models import REGISTRY
+
+        model = obj["model"]
+        if model not in REGISTRY:
+            raise InvalidRequest(
+                f"request {rid!r}: unknown model {model!r}",
+                site="serve.parse")
+        n = opt_int("n", None)   # builders do not validate sizes
+        try:
+            spec = REGISTRY[model](n) if n is not None \
+                else REGISTRY[model]()
+        except (SpecContractError, ValueError, TypeError) as e:
+            raise InvalidRequest(
+                f"request {rid!r}: building {model}({n}) failed: {e}",
+                site="serve.parse", cause=e)
+    else:
+        spec = spec_from_json(obj["spec"])
+        try:   # the spec contract runs at plan time; fail it at ADMISSION
+            for nest in spec.nests:
+                from pluss.spec import flatten_nest
+
+                flatten_nest(nest)
+        except SpecContractError as e:
+            raise InvalidRequest(
+                f"request {rid!r}: spec rejected: {e}",
+                site="serve.parse", cause=e,
+                diagnostics=({"code": e.code, "severity": "ERROR",
+                              "message": str(e)},))
+    total = sum(loop_size(nst) for nst in spec.nests)
+    bound = max_serve_refs()
+    if total > bound:
+        raise InvalidRequest(
+            f"request {rid!r}: stream of {total} accesses exceeds the "
+            f"per-request bound {bound} (PLUSS_SERVE_MAX_REFS)",
+            site="serve.parse")
+    errs = _lint_verdict(spec)
+    if not errs and obj.get("verify"):
+        errs = _analyze_verdict(spec, cfg)
+    if errs:
+        raise InvalidRequest(
+            f"request {rid!r}: spec {spec.name!r} rejected by the static "
+            f"analyzer ({len(errs)} ERROR diagnostic(s))",
+            site="serve.admission", diagnostics=errs)
+    req.spec = spec
+    return req
+
+
+# ---------------------------------------------------------------------------
+# responses
+
+
+def error_response(rid: str | None, err: BaseException) -> dict:
+    """Typed error payload: PlussErrors keep their taxonomy bits; anything
+    else is wrapped as a fatal internal error (no raw tracebacks cross
+    the wire)."""
+    if isinstance(err, PlussError):
+        e = {"type": type(err).__name__, "message": str(err),
+             "retryable": bool(err.retryable),
+             "degradable": bool(err.degradable)}
+        diags = getattr(err, "diagnostics", ())
+        if diags:
+            e["diagnostics"] = list(diags)
+    else:
+        e = {"type": "InternalError",
+             "message": f"{type(err).__name__}: {err}",
+             "retryable": False, "degradable": False}
+    return {"id": rid, "ok": False, "error": e}
+
+
+def result_payload(req: Request, rihist: dict, cfg: SamplerConfig) -> dict:
+    """Shape one request's demuxed result per its ``output`` field.
+    ``rihist`` is the merged reuse-interval histogram (the CRI output for
+    spec requests, ``ReplayResult.histogram()`` for traces)."""
+    from pluss import mrc
+
+    out: dict = {}
+    if req.output in ("mrc", "both"):
+        curve = mrc.aet_mrc(rihist, cfg)
+        out["mrc"] = [[int(c), float(m)] for c, m in mrc.dedup_lines(curve)]
+    if req.output in ("histogram", "both"):
+        out["histogram"] = {str(int(k)): float(v)
+                            for k, v in sorted(rihist.items())}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# client
+
+
+def parse_addr(addr: str) -> tuple:
+    """``host:port`` → a TCP address, anything else → a unix socket path."""
+    if ":" in addr and not addr.startswith("/") and "/" not in addr:
+        host, _, port = addr.rpartition(":")
+        try:
+            return ("tcp", host or "127.0.0.1", int(port))
+        except ValueError:
+            pass
+    return ("unix", addr)
+
+
+class Client:
+    """Minimal JSONL client for one server connection (soak/bench/tests).
+
+    Not thread-safe; one Client per client thread.  ``request`` assigns
+    an id when absent and blocks until THAT id's response arrives
+    (buffering any other ids, which :meth:`request_many` drains)."""
+
+    def __init__(self, addr: str, timeout: float = 120.0):
+        kind, *rest = parse_addr(addr)
+        if kind == "tcp":
+            self._sock = socket.create_connection(
+                (rest[0], rest[1]), timeout=timeout)
+        else:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(timeout)
+            self._sock.connect(rest[0])
+        self._rfile = self._sock.makefile("rb")
+        self._pending: dict[str, dict] = {}
+        self._n = 0
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def send(self, obj: dict) -> str:
+        """Fire one request without waiting; returns its id."""
+        if obj.get("id") is None:
+            self._n += 1
+            obj = {**obj, "id": f"c{self._n}"}
+        self._sock.sendall(json.dumps(obj).encode() + b"\n")
+        return str(obj["id"])
+
+    def _read_one(self) -> dict:
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("server closed the connection")
+        return json.loads(line)
+
+    def recv(self, rid: str) -> dict:
+        """Block until the response for ``rid`` arrives."""
+        if rid in self._pending:
+            return self._pending.pop(rid)
+        while True:
+            resp = self._read_one()
+            if str(resp.get("id")) == rid:
+                return resp
+            self._pending[str(resp.get("id"))] = resp
+
+    def request(self, obj: dict) -> dict:
+        return self.recv(self.send(obj))
+
+    def request_many(self, objs: list[dict]) -> list[dict]:
+        """Pipeline all requests on this connection, then collect every
+        response (order matches ``objs``)."""
+        ids = [self.send(o) for o in objs]
+        return [self.recv(i) for i in ids]

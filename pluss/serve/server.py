@@ -1,0 +1,472 @@
+"""``pluss serve``: the long-lived, multi-tenant MRC prediction daemon.
+
+Process shape (everything host-side except the shared dispatches):
+
+- **listener** (unix socket or localhost TCP) — accepts connections; one
+  reader thread per connection parses JSONL requests and runs the
+  ADMISSION gate (:func:`pluss.serve.protocol.parse_request` — analyzer
+  verdicts, size bounds) *off* the device loop, then submits to the
+  bounded :class:`~pluss.serve.admission.AdmissionQueue` (full queue =
+  typed ``Overloaded`` shed, never a blocked accept path);
+- **device loop** (one thread) — pulls coalesced batches from the
+  :class:`~pluss.serve.batcher.Batcher` and executes each batch as ONE
+  shared dispatch: spec batches through ``run_resilient`` under the
+  process-safe :data:`~pluss.resilience.ladder.SERVE_LADDER` (no
+  ``cpu_fallback`` — a rung must degrade the REQUEST, never pin the
+  process), trace batches through ``replay_file_resilient`` under the
+  equally CPU-pin-free serve trace ladder; results demux per member
+  (:meth:`~pluss.engine.SamplerResult.tenant_view`) and each response is
+  shaped to its own request's ``output``;
+- **SLO publisher** (timer) — p50/p99 latency from a
+  :class:`~pluss.obs.telemetry.LatencyReservoir`, queue depth, batch
+  occupancy, shed rate as ``serve.*`` gauges/counters, re-exported to
+  the Prometheus textfile (``PLUSS_PROM``) every ``prom_refresh_s`` so a
+  scraper sees a LIVE daemon, not only its shutdown snapshot; with a
+  ``heartbeat_dir`` the multihost heartbeat exporter refreshes
+  ``heartbeat_age_s`` gauges on the same cadence.
+
+Failure containment is per REQUEST: an injected fault or real OOM rides
+the resilience ladder inside its own batch; other in-flight requests see
+nothing (the soak harness pins batched results bit-identical to solo
+runs, degraded neighbors included).  Draining (``shutdown()``, SIGTERM,
+or a ``{"op": "shutdown"}`` control line) stops admission, finishes the
+queue, answers everything, flushes telemetry, and exits cleanly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import threading
+import time
+
+from pluss import obs
+from pluss.resilience.errors import DeadlineExceeded, classify
+from pluss.resilience.ladder import SERVE_LADDER, Retry
+from pluss.serve.admission import AdmissionQueue
+from pluss.serve.batcher import Batcher
+from pluss.serve.protocol import (
+    Request,
+    error_response,
+    parse_request,
+    result_payload,
+)
+
+#: trace-replay rung subset for serving: like TRACE_LADDER minus the
+#: process-pinning ``cpu_fallback`` (same reasoning as SERVE_LADDER)
+SERVE_TRACE_LADDER: tuple[str, ...] = ("serial_feed", "shrink_window")
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Serving knobs (CLI flags mirror these 1:1)."""
+
+    max_queue: int = 128          # admission bound (beyond = shed)
+    max_batch: int = 16           # coalesced requests per dispatch
+    max_delay_ms: float = 10.0    # adaptive batch window
+    default_deadline_ms: float | None = None   # per-request default
+    prom_refresh_s: float = 5.0   # SLO gauge + textfile refresh cadence
+    heartbeat_dir: str | None = None   # arm the fleet-health exporter
+    num_processes: int | None = None   # heartbeat worker count
+
+
+class Server:
+    """One serving process bound to a unix socket path or a TCP port."""
+
+    def __init__(self, socket_path: str | None = None,
+                 port: int | None = None, host: str = "127.0.0.1",
+                 config: ServeConfig | None = None):
+        if (socket_path is None) == (port is None):
+            raise ValueError("pass exactly one of socket_path / port")
+        self.socket_path = socket_path
+        self.host, self.port = host, port
+        self.config = config or ServeConfig()
+        self.queue = AdmissionQueue(self.config.max_queue)
+        self.batcher = Batcher(self.queue, self.config.max_batch,
+                               self.config.max_delay_ms)
+        self.latency = obs.LatencyReservoir()
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+        self._conn_lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._shutdown_lock = threading.Lock()
+        self._shutdown_started = False
+        self._drained = threading.Event()
+        self._stop_requested = threading.Event()   # control-line shutdown
+        self._hb_stop = None
+        self._slo_lock = threading.Lock()
+        self._responses = 0
+        self._last_publish = 0.0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Bind, start the accept loop, device loop, and SLO publisher."""
+        if self.socket_path is not None:
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+            ls = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            ls.bind(self.socket_path)
+        else:
+            ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            ls.bind((self.host, self.port))
+            self.port = ls.getsockname()[1]   # resolve port 0
+        ls.listen(64)
+        self._listener = ls
+        obs.event("serve.start",
+                  addr=self.socket_path or f"{self.host}:{self.port}",
+                  max_queue=self.config.max_queue,
+                  max_batch=self.config.max_batch,
+                  max_delay_ms=self.config.max_delay_ms)
+        for name, target in (("pluss-serve-accept", self._accept_loop),
+                             ("pluss-serve-device", self._device_loop),
+                             ("pluss-serve-slo", self._slo_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self.config.heartbeat_dir:
+            from pluss.parallel.multihost import start_heartbeat_exporter
+
+            self._hb_stop = start_heartbeat_exporter(
+                self.config.heartbeat_dir,
+                self.config.num_processes or 1,
+                interval_s=self.config.prom_refresh_s)
+
+    @property
+    def address(self) -> str:
+        return self.socket_path or f"{self.host}:{self.port}"
+
+    def serve_forever(self) -> None:
+        """Block until a signal or a shutdown control line, then drain.
+        Starts the server if :meth:`start` was not called already.  Call
+        only from the main thread (signal handlers)."""
+        import signal
+
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: self._stop_requested.set())
+        if self._listener is None:
+            self.start()
+        self._stop_requested.wait()
+        self.shutdown()
+
+    def shutdown(self, drain_timeout_s: float = 60.0) -> None:
+        """Drain-and-stop: close admission, finish every queued request,
+        answer everything, flush telemetry.  Idempotent."""
+        with self._shutdown_lock:   # atomic test-and-set: the control-
+            # line path and serve_forever's signal path can race here
+            already = self._shutdown_started
+            self._shutdown_started = True
+        if already:
+            self._drained.wait(drain_timeout_s)
+            return
+        # order matters: close ADMISSION first, then flag the stop.  The
+        # device loop exits on (stopping AND queue empty); with the queue
+        # closed first, a submit racing this window sheds typed instead
+        # of landing in a queue nobody will ever drain.
+        self.queue.close()
+        self._stopping.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if not self._threads:   # never started: nothing will drain
+            self._drained.set()
+        self._drained.wait(drain_timeout_s)
+        if self._hb_stop is not None:
+            self._hb_stop()
+        self._publish_slo(force=True)
+        obs.event("serve.stop", responses=self._responses)
+        obs.flush_metrics()
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self.socket_path is not None:
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+    # -- listener / connections ---------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                if self._stopping.is_set():
+                    return   # listener closed by shutdown
+                # transient accept failure (EMFILE under connection
+                # pressure, interrupted call): a daemon must keep
+                # accepting, not silently stop serving new connections
+                obs.counter_add("serve.accept_errors")
+                time.sleep(0.05)
+                continue
+            with self._conn_lock:
+                self._conns.append(conn)
+            t = threading.Thread(target=self._conn_loop, args=(conn,),
+                                 name="pluss-serve-conn", daemon=True)
+            t.start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+
+        def reply(doc: dict) -> None:
+            data = json.dumps(doc).encode() + b"\n"
+            try:
+                with wlock:
+                    conn.sendall(data)
+            except OSError:
+                obs.counter_add("serve.client_gone")
+
+        try:
+            rfile = conn.makefile("rb")
+            for line in rfile:
+                if not line.strip():
+                    continue
+                self._handle_line(line, reply)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conn_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def _handle_line(self, line: bytes, reply) -> None:
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            from pluss.resilience.errors import InvalidRequest
+
+            obs.counter_add("serve.requests")
+            obs.counter_add("serve.admission_rejects")
+            self._respond_err(reply, None, InvalidRequest(
+                f"unparseable request line: {e}", site="serve.parse"))
+            return
+        op = obj.get("op") if isinstance(obj, dict) else None
+        if op is not None:   # control lines are not requests (no SLO)
+            self._handle_control(op, obj, reply)
+            return
+        obs.counter_add("serve.requests")
+        try:
+            req = parse_request(obj, self.config.default_deadline_ms)
+        except Exception as e:  # noqa: BLE001 — typed response, no escape
+            obs.counter_add("serve.admission_rejects")
+            rid = obj.get("id") if isinstance(obj, dict) else None
+            self._respond_err(reply, rid if rid is None else str(rid),
+                              classify(e, site="serve.parse"))
+            return
+        obs.counter_add(f"serve.requests.{req.kind}")
+        req.reply = reply
+        try:
+            self.queue.submit(req)
+        except Exception as e:  # noqa: BLE001 — Overloaded et al, typed
+            self._respond_err(reply, req.id, classify(
+                e, site="serve.admission"))
+
+    def _handle_control(self, op: str, obj: dict, reply) -> None:
+        if op == "ping":
+            reply({"id": obj.get("id"), "ok": True, "op": "ping"})
+        elif op == "stats":
+            reply({"id": obj.get("id"), "ok": True, "op": "stats",
+                   "counters": obs.counters(), "gauges": obs.gauges(),
+                   "queue_depth": len(self.queue)})
+        elif op == "shutdown":
+            # ack first, THEN signal: the drain closes this connection
+            reply({"id": obj.get("id"), "ok": True, "op": "shutdown",
+                   "draining": True})
+            self._stop_requested.set()
+            # in-process embeddings (tests) have no serve_forever waiting
+            # on the event; shut down from a helper thread (never from
+            # this conn thread: shutdown joins the drain that must still
+            # answer other connections)
+            threading.Thread(target=self.shutdown, daemon=True,
+                             name="pluss-serve-shutdown").start()
+        else:
+            from pluss.resilience.errors import InvalidRequest
+
+            reply(error_response(obj.get("id"), InvalidRequest(
+                f"unknown op {op!r}", site="serve.parse")))
+
+    # -- device loop --------------------------------------------------------
+
+    def _device_loop(self) -> None:
+        while True:
+            batch, expired = self.batcher.next_batch(timeout=0.25)
+            for req in expired:
+                self._respond_deadline(req)
+            if not batch:
+                if self._stopping.is_set() and len(self.queue) == 0:
+                    self._drained.set()
+                    return
+                continue
+            self._execute(batch)
+
+    def _execute(self, batch: list[Request]) -> None:
+        # members can expire between batching and dispatch
+        live = []
+        for req in batch:
+            if req.expired():
+                self._respond_deadline(req)
+            else:
+                live.append(req)
+        if not live:
+            return
+        lead = live[0]
+        with obs.span("serve.batch", kind=lead.kind, size=len(live)):
+            try:
+                if lead.kind == "sleep":
+                    time.sleep(lead.sleep_ms / 1e3)
+                    self._respond_ok(lead, {"slept_ms": lead.sleep_ms},
+                                     len(live))
+                    return
+                if lead.kind == "spec":
+                    self._execute_spec(live)
+                else:
+                    self._execute_trace(live)
+            except BaseException as e:  # noqa: BLE001 — typed fan-out
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    raise
+                err = classify(e, site=f"serve.{lead.kind}")
+                if isinstance(err, DeadlineExceeded):
+                    # a deadline blown INSIDE the ladder must land in the
+                    # same SLO counter as the queue/demux expiry paths
+                    obs.counter_add("serve.deadline_exceeded", len(live))
+                for req in live:
+                    self._respond_err(req.reply, req.id, err)
+
+    @staticmethod
+    def _batch_deadline_s(batch: list[Request]) -> float | None:
+        """Ladder churn budget of one dispatch: the LONGEST remaining
+        member deadline (a retry that can still save one member is worth
+        taking; members it cannot save fail their own deadline check at
+        demux)."""
+        rems = [r.remaining_s() for r in batch]
+        if any(r is None for r in rems):
+            return None
+        return max(rems)
+
+    def _execute_spec(self, batch: list[Request]) -> None:
+        from pluss import cri
+        from pluss.resilience.ladder import run_resilient
+
+        lead = batch[0]
+        res = run_resilient(
+            lead.spec, lead.cfg, lead.share_cap,
+            window_accesses=lead.window, rungs=SERVE_LADDER,
+            retry=Retry(backoff_s=0.01),
+            deadline_s=self._batch_deadline_s(batch))
+        k = len(batch)
+        for req in batch:
+            if req.expired():
+                self._respond_deadline(req)
+                continue
+            # demux: each tenant gets an independently-owned result view,
+            # then its own CRI pass + shaping (deterministic on equal
+            # inputs, so coalesced responses stay bit-identical to solo)
+            view = res.tenant_view()
+            ri = cri.distribute(view.noshare_list(), view.share_list(),
+                                req.cfg.thread_num)
+            payload = result_payload(req, ri, req.cfg)
+            payload["model"] = req.spec.name
+            payload["refs"] = int(view.max_iteration_count)
+            if view.degradations:
+                payload["degradations"] = list(view.degradations)
+            self._respond_ok(req, payload, k)
+
+    def _execute_trace(self, batch: list[Request]) -> None:
+        from pluss import trace as trace_mod
+        from pluss.resilience.ladder import replay_file_resilient
+
+        lead = batch[0]
+        rep = replay_file_resilient(
+            lead.trace, lead.fmt, cls=lead.cfg.cls,
+            window=lead.window or trace_mod.TRACE_WINDOW,
+            rungs=SERVE_TRACE_LADDER, retry=Retry(backoff_s=0.01))
+        k = len(batch)
+        for req in batch:
+            if req.expired():
+                self._respond_deadline(req)
+                continue
+            payload = result_payload(req, rep.histogram(), req.cfg)
+            payload["trace"] = req.trace
+            payload["refs"] = int(rep.total_count)
+            payload["n_lines"] = int(rep.n_lines)
+            if rep.degradations:
+                payload["degradations"] = list(rep.degradations)
+            self._respond_ok(req, payload, k)
+
+    # -- responses / SLO ----------------------------------------------------
+
+    def _finish(self, req_or_none, ms: float | None) -> None:
+        with self._slo_lock:
+            self._responses += 1
+            n = self._responses
+        if ms is not None:
+            self.latency.add(ms)
+        if n % 32 == 0:
+            self._publish_slo()
+
+    def _respond_ok(self, req: Request, payload: dict, k: int) -> None:
+        ms = (time.monotonic() - req.t_admit) * 1e3
+        doc = {"id": req.id, "ok": True, **payload,
+               "batched": k, "latency_ms": round(ms, 3)}
+        # count BEFORE replying: a client that reads counters right after
+        # its response (the stats op, tests) must see itself counted
+        obs.counter_add("serve.ok")
+        self._finish(req, ms)
+        req.reply(doc)
+
+    def _respond_err(self, reply, rid, err) -> None:
+        obs.counter_add("serve.errors")
+        self._finish(None, None)
+        reply(error_response(rid, err))
+
+    def _respond_deadline(self, req: Request) -> None:
+        obs.counter_add("serve.deadline_exceeded")
+        self._respond_err(req.reply, req.id, DeadlineExceeded(
+            "deadline passed before the result was produced",
+            site="serve.deadline"))
+
+    def _publish_slo(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._slo_lock:
+            if not force and now - self._last_publish < 0.5:
+                return
+            self._last_publish = now
+        p50 = self.latency.quantile(0.50)
+        p99 = self.latency.quantile(0.99)
+        if p50 is not None:
+            obs.gauge_set("serve.p50_ms", round(p50, 3))
+        if p99 is not None:
+            obs.gauge_set("serve.p99_ms", round(p99, 3))
+        obs.gauge_set("serve.queue_depth", float(len(self.queue)))
+
+    def _slo_loop(self) -> None:
+        interval = max(self.config.prom_refresh_s, 0.1)
+        while not self._stopping.wait(interval):
+            self._publish_slo(force=True)
+            tel = obs.active()
+            if tel is not None and tel.prom_path:
+                try:
+                    tel.write_prom()
+                except OSError:
+                    pass

@@ -36,6 +36,19 @@ JAX_PLATFORMS=cpu PLUSS_TELEMETRY="$PLUSS_OBS_LOG" \
 python -m pluss.cli stats "$PLUSS_OBS_LOG" --check 1>&2
 rm -f "$PLUSS_OBS_LOG"
 
+# serve smoke (tier-1): spawn a real `pluss serve` daemon on a unix socket
+# and drive ~20 mixed spec/trace requests through the soak load generator —
+# including a forced-degraded request (injected OOM ridden through the
+# process-safe serve ladder) and a forced shed (admission-bound burst →
+# typed Overloaded) — with every response bit-compared against a solo run,
+# then drain-and-stop cleanly and schema-check the daemon's telemetry
+# stream (the serve SLO block in `pluss stats` reads off this same file).
+PLUSS_SERVE_LOG=$(mktemp /tmp/pluss_serve_XXXX.jsonl)
+JAX_PLATFORMS=cpu python soak.py --serve 20 "${PLUSS_SERVE_SEED:-20260804}" \
+  --telemetry "$PLUSS_SERVE_LOG" 1>&2
+python -m pluss.cli stats "$PLUSS_SERVE_LOG" --check 1>&2
+rm -f "$PLUSS_SERVE_LOG"
+
 # opt-in chaos smoke (PLUSS_CHAOS=1): a short seeded fault-plan soak on the
 # CPU backend — every injected fault (OOM / compile / share-cap / corrupt
 # cache) must either recover to a bit-exact result via the degradation

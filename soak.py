@@ -1,4 +1,4 @@
-"""Seeded soaks: hypothesis property soak + chaos (fault-plan) soak.
+"""Seeded soaks: property soak, chaos (fault-plan) soak, and serve soak.
 
 Property soak (default): re-wraps tests/test_property.py's differential
 properties with a larger example budget and a fresh seed.  Not part of the
@@ -13,6 +13,28 @@ BIT-IDENTICAL to the clean run or fail with a classified ``PlussError``
 — a raw XLA/OS exception escaping is a soak failure.  The seed is printed
 so any failure replays exactly.  Needs no hypothesis install (run.sh's
 opt-in chaos smoke uses it on bare images).
+
+Serve soak (``python soak.py --serve N [seed] [--chaos]
+[--telemetry PATH]``): the load generator for the ``pluss serve`` daemon.
+Spawns a real daemon subprocess (CPU backend, telemetry armed, a fault
+plan injected via ``PLUSS_FAULT_PLAN`` — a fixed early OOM by default,
+a seeded random plan under ``--chaos``), then:
+
+1. forces a SHED: a ``sleep_ms`` request holds the device loop while a
+   burst overflows the admission bound — the overflow must come back as
+   typed ``Overloaded`` errors, never silence or a crash;
+2. drives N interleaved requests (registry models at several schedules,
+   an inline-JSON spec, packed-trace replays) from concurrent client
+   threads, with every response compared BIT-IDENTICAL (mrc + histogram)
+   against a solo in-process run of the same prediction — including the
+   response(s) the injected fault degraded through the ladder, and every
+   neighbor in their batches;
+3. drains the daemon cleanly (``{"op": "shutdown"}``) and checks it
+   exited 0.
+
+Failures (missing shed, missing degradation, any divergence, raw errors,
+unclean exit) are counted and exit nonzero.  run.sh's tier-1 serve smoke
+is ``soak.py --serve 20`` + ``pluss stats --check`` on the stream.
 """
 
 import sys
@@ -109,6 +131,223 @@ def chaos(n_rounds: int, sd: int) -> int:
     return 1 if failures else 0
 
 
+def serve(n_requests: int, sd: int, chaos: bool,
+          telemetry: str | None) -> int:
+    import json
+    import os
+    import subprocess
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    os.environ.pop("PLUSS_FAULT_PLAN", None)   # solo baselines stay clean
+    from pluss.utils.platform import enable_x64, force_cpu
+
+    force_cpu()
+    enable_x64()
+    from pluss import cri, engine, mrc, trace
+    from pluss.config import SamplerConfig
+    from pluss.models import REGISTRY
+    from pluss.serve import Client
+    from pluss.serve.protocol import spec_to_json
+
+    tmp = tempfile.mkdtemp(prefix="pluss_serve_soak_")
+    sock = os.path.join(tmp, "serve.sock")
+    tel = telemetry or os.path.join(tmp, "serve_telemetry.jsonl")
+    trace_path = os.path.join(tmp, "refs.bin")
+    rng_np = np.random.default_rng(sd)
+    rng_np.integers(0, 4096, 20_000).astype("<u8").tofile(trace_path)
+
+    # request pool: mixed kinds, several schedules, >1 distinct batch key
+    inline = spec_to_json(REGISTRY["gemm"](13))
+    inline["name"] = "tenant_gemm13"
+    pool = [
+        {"model": "gemm", "n": 16, "threads": 2, "chunk": 2},
+        {"model": "mvt", "n": 16, "threads": 4, "chunk": 4},
+        {"model": "syrk", "n": 12, "threads": 2, "chunk": 4},
+        {"spec": inline, "threads": 2, "chunk": 2},
+        {"trace": trace_path},
+    ]
+
+    max_queue = 4
+    if chaos:
+        from pluss.resilience import FaultPlan
+
+        fault_plan = FaultPlan.random(sd, n_faults=2).describe()
+    else:
+        # fixed early OOM: the FIRST engine dispatch of the daemon fails
+        # injected and must recover through the serve ladder
+        fault_plan = "oom@1"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PLUSS_FAULT_PLAN": fault_plan,
+           "PLUSS_PLAN_CACHE_DIR": os.path.join(tmp, "plan_cache")}
+    env.pop("PLUSS_TELEMETRY", None)   # the daemon gets --telemetry
+    err_path = os.path.join(tmp, "daemon.err")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "pluss.cli", "serve", "--socket", sock,
+         "--cpu", "--telemetry", tel, "--max-batch", "8",
+         "--max-queue", str(max_queue), "--max-delay-ms", "25"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env, stderr=open(err_path, "w"))
+    print(f"serve soak seed {sd}: daemon pid {daemon.pid}, fault plan "
+          f"{fault_plan!r}, telemetry {tel}", flush=True)
+    for _ in range(240):
+        if os.path.exists(sock) or daemon.poll() is not None:
+            break
+        time.sleep(0.5)
+    failures = 0
+    if daemon.poll() is not None or not os.path.exists(sock):
+        print("serve soak: daemon failed to come up; stderr tail:")
+        print(open(err_path).read()[-2000:])
+        return 1
+    try:
+        # ---- phase 1: force a shed (typed Overloaded, never a crash)
+        holder = Client(sock)
+        hid = holder.send({"sleep_ms": 1200})
+        time.sleep(0.2)   # let the hold reach the device loop
+        with Client(sock) as burst:
+            ids = [burst.send({"model": "gemm", "n": 16, "threads": 2,
+                               "chunk": 2}) for _ in range(max_queue + 6)]
+            outcomes = [burst.recv(i) for i in ids]
+        shed = [r for r in outcomes
+                if not r.get("ok")
+                and r.get("error", {}).get("type") == "Overloaded"]
+        raw = [r for r in outcomes
+               if not r.get("ok")
+               and r.get("error", {}).get("type")
+               not in ("Overloaded", "DeadlineExceeded")]
+        # the injected fault may fire on the BURST's dispatch (it is the
+        # daemon's first) — degradations there count, and served burst
+        # responses join the bit-compare below
+        phase1_degraded = sum(1 for r in outcomes
+                              if r.get("ok") and r.get("degradations"))
+        print(f"serve soak: shed burst -> {len(shed)} Overloaded, "
+              f"{sum(1 for r in outcomes if r.get('ok'))} served, "
+              f"{phase1_degraded} degraded", flush=True)
+        if not shed:
+            print("serve soak: FAIL — burst past the admission bound "
+                  "shed nothing")
+            failures += 1
+        if raw:
+            print(f"serve soak: FAIL — untyped burst errors: {raw[:2]}")
+            failures += 1
+        holder.recv(hid)
+        holder.close()
+
+        # ---- phase 2: N mixed requests from concurrent clients
+        rng = __import__("random").Random(sd)
+        reqs = [dict(rng.choice(pool), output="both", id=f"r{i}")
+                for i in range(n_requests)]
+        responses: dict[str, dict] = {}
+        rlock = threading.Lock()
+
+        def worker(chunk):
+            with Client(sock) as c:
+                for q in chunk:
+                    r = c.request(q)
+                    with rlock:
+                        responses[q["id"]] = r
+
+        n_workers = min(4, max(1, n_requests))
+        chunks = [reqs[i::n_workers] for i in range(n_workers)]
+        threads = [threading.Thread(target=worker, args=(ch,))
+                   for ch in chunks if ch]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+
+        # ---- solo baselines (clean in-process runs), then bit-compare
+        solo: dict[str, dict] = {}
+
+        def solo_payload(q) -> dict:
+            cfg = SamplerConfig(thread_num=q.get("threads", 4),
+                                chunk_size=q.get("chunk", 4))
+            if "trace" in q:
+                ri = trace.replay_file(q["trace"], "u64",
+                                       cls=cfg.cls).histogram()
+            else:
+                if "model" in q:
+                    spec = REGISTRY[q["model"]](q["n"])
+                else:
+                    from pluss.serve.protocol import spec_from_json
+
+                    spec = spec_from_json(q["spec"])
+                res = engine.run(spec, cfg)
+                ri = cri.distribute(res.noshare_list(), res.share_list(),
+                                    cfg.thread_num)
+            curve = mrc.aet_mrc(ri, cfg)
+            return {"mrc": [[int(c), float(m)]
+                            for c, m in mrc.dedup_lines(curve)],
+                    "histogram": {str(int(k)): float(v)
+                                  for k, v in sorted(ri.items())}}
+
+        def key_of(q) -> str:
+            return json.dumps({k: q[k] for k in
+                               ("model", "n", "spec", "trace", "threads",
+                                "chunk") if k in q}, sort_keys=True)
+
+        degraded = phase1_degraded
+        mismatches = 0
+        burst_q = dict(pool[0], output="both")
+        bk = key_of(burst_q)
+        solo[bk] = solo_payload(burst_q)
+        for r in outcomes:
+            if r.get("ok") and r.get("mrc") != solo[bk]["mrc"]:
+                mismatches += 1
+                print("serve soak: FAIL — a burst response diverged "
+                      f"(degradations={r.get('degradations')})")
+        for q in reqs:
+            r = responses.get(q["id"])
+            if r is None or not r.get("ok"):
+                print(f"serve soak: FAIL — {q['id']} got {r}")
+                failures += 1
+                continue
+            k = key_of(q)
+            if k not in solo:
+                solo[k] = solo_payload(q)
+            if r.get("degradations"):
+                degraded += 1
+            if r["mrc"] != solo[k]["mrc"] \
+                    or r["histogram"] != solo[k]["histogram"]:
+                mismatches += 1
+                print(f"serve soak: FAIL — {q['id']} diverged from the "
+                      f"solo run (degradations={r.get('degradations')})")
+        if mismatches:
+            failures += 1
+        if not chaos and not degraded:
+            # the fixed oom@1 plan must have degraded SOMETHING
+            print("serve soak: FAIL — injected fault degraded no request")
+            failures += 1
+        occup = len([r for r in responses.values() if r.get("ok")])
+        batches = {r.get("batched") for r in responses.values()
+                   if r.get("ok")}
+        print(f"serve soak: {n_requests} mixed requests in {dt:.1f}s "
+              f"({n_requests / dt:.1f} req/s), {occup} ok, "
+              f"{degraded} degraded via the ladder, {mismatches} "
+              f"divergence(s); batch occupancies seen {sorted(batches)}",
+              flush=True)
+
+        # ---- drain and stop
+        with Client(sock) as c:
+            c.request({"op": "shutdown"})
+        rc = daemon.wait(timeout=60)
+        if rc != 0:
+            print(f"serve soak: FAIL — daemon exited {rc}; stderr tail:")
+            print(open(err_path).read()[-2000:])
+            failures += 1
+    finally:
+        if daemon.poll() is None:
+            daemon.kill()
+            daemon.wait()
+    print(f"serve soak: {failures} failure(s), seed {sd}; telemetry "
+          f"stream at {tel}", flush=True)
+    return 1 if failures else 0
+
+
 def soak(name, inner, budget, sd, **strats):
     from hypothesis import HealthCheck, given, seed, settings
 
@@ -124,6 +363,18 @@ def soak(name, inner, budget, sd, **strats):
 
 def main():
     sys.path.insert(0, ".")
+    if len(sys.argv) > 1 and sys.argv[1] == "--serve":
+        rest = sys.argv[2:]
+        tel = None
+        if "--telemetry" in rest:
+            i = rest.index("--telemetry")
+            tel = rest[i + 1]
+            del rest[i:i + 2]
+        chaos_flag = "--chaos" in rest
+        rest = [a for a in rest if a != "--chaos"]
+        n = int(rest[0]) if rest else 20
+        sd = int(rest[1]) if len(rest) > 1 else int(time.time())
+        sys.exit(serve(n, sd, chaos_flag, tel))
     if len(sys.argv) > 1 and sys.argv[1] == "--chaos":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 5
         sd = int(sys.argv[3]) if len(sys.argv) > 3 else int(time.time())

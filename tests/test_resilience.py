@@ -654,12 +654,19 @@ def test_readme_failure_model_is_synced():
                                "README.md")).read()
     start = readme.index("## Failure model & recovery")
     section = readme[start:]
+    from pluss.resilience.ladder import SERVE_LADDER
+
     for cls_ in (errors.PlussError, errors.ResourceExhausted,
                  errors.CompileError, errors.ShareCapOverflow,
                  errors.CollectiveError, errors.WorkerDied,
-                 errors.DataLoss, errors.CacheCorrupt):
+                 errors.DataLoss, errors.CacheCorrupt,
+                 errors.Overloaded, errors.DeadlineExceeded,
+                 errors.InvalidRequest):
         assert cls_.__name__ in section, f"missing {cls_.__name__}"
-    for rung in set(LADDER) | set(SHARD_LADDER) | set(TRACE_LADDER):
+    assert "SERVE_LADDER" in section, \
+        "the serve rung subset must be documented with the ladders"
+    for rung in set(LADDER) | set(SHARD_LADDER) | set(TRACE_LADDER) \
+            | set(SERVE_LADDER):
         assert rung in section, f"missing ladder rung {rung}"
     for kind in KIND_SITE:
         assert kind in section, f"missing fault kind {kind}"

@@ -1,0 +1,342 @@
+"""Admission queue + batcher semantics, the bounded-LRU disk plan cache,
+and the engine's serving-facing demux surface."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (CPU platform + x64)
+from pluss import engine
+from pluss.config import SamplerConfig
+from pluss.models import REGISTRY
+from pluss.resilience.errors import Overloaded
+from pluss.serve.admission import AdmissionQueue
+from pluss.serve.batcher import Batcher
+from pluss.serve.protocol import parse_request
+
+
+def req(i=None, model="gemm", n=16, **kw):
+    obj = {"model": model, "n": n, "threads": 2, **kw}
+    if i is not None:
+        obj["id"] = str(i)
+    return parse_request(obj)
+
+
+# ---------------------------------------------------------------------------
+# admission queue
+
+
+def test_queue_fifo_and_len():
+    q = AdmissionQueue(max_queue=8)
+    for i in range(3):
+        q.submit(req(i))
+    assert len(q) == 3
+    got, expired = q.pop(timeout=0)
+    assert got.id == "0" and not expired
+    assert [q.pop(0)[0].id for _ in range(2)] == ["1", "2"]
+
+
+def test_queue_sheds_at_bound_with_typed_error():
+    q = AdmissionQueue(max_queue=2)
+    q.submit(req(0))
+    q.submit(req(1))
+    with pytest.raises(Overloaded) as ei:
+        q.submit(req(2))
+    assert ei.value.retryable, "clients may retry a shed after backoff"
+    assert len(q) == 2, "the shed request must not occupy a slot"
+
+
+def test_queue_closed_sheds_and_drains():
+    q = AdmissionQueue(max_queue=8)
+    q.submit(req(0))
+    q.close()
+    with pytest.raises(Overloaded):
+        q.submit(req(1))
+    got, _ = q.pop(timeout=0)
+    assert got.id == "0", "queued work drains after close"
+    got, _ = q.pop(timeout=0)
+    assert got is None
+
+
+def test_queue_pop_surfaces_expired():
+    q = AdmissionQueue(max_queue=8)
+    dead = req(0, deadline_ms=1)
+    q.submit(dead)
+    q.submit(req(1))
+    time.sleep(0.01)
+    got, expired = q.pop(timeout=0)
+    assert got.id == "1"
+    assert [r.id for r in expired] == ["0"]
+
+
+def test_queue_take_matching_preserves_rest():
+    q = AdmissionQueue(max_queue=16)
+    a0, b0, a1, c0, a2 = (req(0), req(1, model="mvt"), req(2),
+                          req(3, n=12), req(4))
+    for r in (a0, b0, a1, c0, a2):
+        q.submit(r)
+    got, expired = q.take_matching(a0.batch_key(), limit=10)
+    assert [r.id for r in got] == ["0", "2", "4"]
+    assert not expired
+    assert [q.pop(0)[0].id for _ in range(2)] == ["1", "3"]
+
+
+def test_queue_take_matching_limit():
+    q = AdmissionQueue(max_queue=16)
+    for i in range(5):
+        q.submit(req(i))
+    got, _ = q.take_matching(req().batch_key(), limit=2)
+    assert len(got) == 2 and len(q) == 3
+
+
+def test_queue_take_matching_drains_expired_matches():
+    """An expired same-key request must be REMOVED (and handed back for
+    a DeadlineExceeded reply), not left queued — a left-behind entry
+    would make the batcher's linger loop spin on a non-empty queue that
+    never yields a member."""
+    q = AdmissionQueue(max_queue=16)
+    dead = req(0, deadline_ms=1)
+    q.submit(dead)
+    q.submit(req(1))
+    time.sleep(0.01)
+    got, expired = q.take_matching(dead.batch_key(), limit=10)
+    assert [r.id for r in got] == ["1"]
+    assert [r.id for r in expired] == ["0"]
+    assert len(q) == 0
+
+
+def test_queue_validation():
+    with pytest.raises(ValueError):
+        AdmissionQueue(max_queue=0)
+
+
+# ---------------------------------------------------------------------------
+# batcher
+
+
+def test_batcher_coalesces_compatible():
+    q = AdmissionQueue(max_queue=32)
+    b = Batcher(q, max_batch=8, max_delay_ms=0)
+    for i in range(5):
+        q.submit(req(i))
+    q.submit(req(9, model="mvt"))
+    batch, expired = b.next_batch(timeout=0)
+    assert [r.id for r in batch] == ["0", "1", "2", "3", "4"]
+    assert not expired
+    batch, _ = b.next_batch(timeout=0)
+    assert [r.id for r in batch] == ["9"]
+
+
+def test_batcher_max_batch_cap():
+    q = AdmissionQueue(max_queue=32)
+    b = Batcher(q, max_batch=3, max_delay_ms=0)
+    for i in range(5):
+        q.submit(req(i))
+    assert len(b.next_batch(timeout=0)[0]) == 3
+    assert len(b.next_batch(timeout=0)[0]) == 2
+
+
+def test_batcher_unbatched_mode():
+    q = AdmissionQueue(max_queue=32)
+    b = Batcher(q, max_batch=1, max_delay_ms=50)
+    for i in range(3):
+        q.submit(req(i))
+    t0 = time.monotonic()
+    assert len(b.next_batch(timeout=0)[0]) == 1
+    assert time.monotonic() - t0 < 0.04, "max_batch=1 must never linger"
+
+
+def test_batcher_adaptive_window_catches_straggler():
+    q = AdmissionQueue(max_queue=32)
+    b = Batcher(q, max_batch=8, max_delay_ms=200)
+    q.submit(req(0))
+
+    def straggle():
+        time.sleep(0.03)
+        q.submit(req(1))
+
+    t = threading.Thread(target=straggle)
+    t.start()
+    batch, _ = b.next_batch(timeout=0)
+    t.join()
+    assert [r.id for r in batch] == ["0", "1"], \
+        "the adaptive window must pick up a straggler within max_delay"
+
+
+def test_batcher_ships_early_when_other_work_waits():
+    q = AdmissionQueue(max_queue=32)
+    b = Batcher(q, max_batch=8, max_delay_ms=10_000)
+    q.submit(req(0))
+    q.submit(req(1, model="mvt"))
+    t0 = time.monotonic()
+    batch, _ = b.next_batch(timeout=0)
+    assert [r.id for r in batch] == ["0"]
+    assert time.monotonic() - t0 < 1.0, \
+        "unrelated queued work must abort the linger immediately"
+
+
+def test_batcher_singleton_ships_after_delay():
+    q = AdmissionQueue(max_queue=32)
+    b = Batcher(q, max_batch=8, max_delay_ms=30)
+    q.submit(req(0))
+    t0 = time.monotonic()
+    batch, _ = b.next_batch(timeout=0)
+    dt = time.monotonic() - t0
+    assert [r.id for r in batch] == ["0"]
+    assert dt < 1.0
+
+
+def test_batcher_validation():
+    q = AdmissionQueue(max_queue=2)
+    with pytest.raises(ValueError):
+        Batcher(q, max_batch=0)
+    with pytest.raises(ValueError):
+        Batcher(q, max_delay_ms=-1)
+
+
+# ---------------------------------------------------------------------------
+# bounded-LRU disk plan cache
+
+
+@pytest.fixture
+def plan_cache_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("PLUSS_NO_PLAN_CACHE", raising=False)
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_DIR", str(tmp_path))
+    return tmp_path
+
+
+def _entries(root):
+    return sorted(p.name for p in root.iterdir() if p.name.endswith(".pkl"))
+
+
+def test_plan_cache_lru_eviction(plan_cache_dir, monkeypatch):
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_MAX", "2")
+    for i, key in enumerate(["k1", "k2", "k3"]):
+        engine._plan_cache_put(key, {"tpl": None, "overlays": ()})
+        os.utime(plan_cache_dir / f"{key}.pkl", (i, i))   # force ordering
+    engine._plan_cache_evict()
+    assert _entries(plan_cache_dir) == ["k2.pkl", "k3.pkl"], \
+        "the oldest entry must be evicted past the cap"
+
+
+def test_plan_cache_hit_refreshes_recency(plan_cache_dir, monkeypatch):
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_MAX", "2")
+    engine._plan_cache_put("hot", {"tpl": None, "overlays": ()})
+    os.utime(plan_cache_dir / "hot.pkl", (1, 1))    # oldest by mtime...
+    engine._plan_cache_put("warm", {"tpl": None, "overlays": ()})
+    # pin warm well in the past too (tmpfs mtime granularity is coarse —
+    # a same-tick tie would make the eviction order arbitrary); the HIT
+    # below must refresh hot far past both
+    os.utime(plan_cache_dir / "warm.pkl", (2, 2))
+    assert engine._plan_cache_get("hot") is not None   # ...but HIT now
+    assert (plan_cache_dir / "hot.pkl").stat().st_mtime > 2, \
+        "a cache hit must touch the entry's mtime"
+    engine._plan_cache_put("new", {"tpl": None, "overlays": ()})
+    assert "hot.pkl" in _entries(plan_cache_dir), \
+        "a hit must refresh LRU recency: the untouched entry evicts first"
+    assert "warm.pkl" not in _entries(plan_cache_dir)
+
+
+def test_plan_cache_evict_counter(plan_cache_dir, monkeypatch, tmp_path):
+    from pluss import obs
+
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_MAX", "1")
+    sink = tmp_path / "tel.jsonl"
+    obs.configure(str(sink))
+    try:
+        for key in ("a", "b", "c"):
+            engine._plan_cache_put(key, {"tpl": None})
+        assert obs.counters().get("engine.plan_cache.evict") == 2
+    finally:
+        obs.shutdown()
+
+
+def test_plan_cache_unbounded_when_disabled(plan_cache_dir, monkeypatch):
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_MAX", "0")
+    for i in range(5):
+        engine._plan_cache_put(f"k{i}", {"tpl": None})
+    assert len(_entries(plan_cache_dir)) == 5
+
+
+def test_plan_cache_real_plan_round_trip(plan_cache_dir, monkeypatch):
+    """A real planned spec still round-trips through the capped cache
+    (the eviction path must not corrupt the artifact discipline)."""
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_MAX", "4")
+    engine.compiled.cache_clear()
+    spec = REGISTRY["gemm"](16)
+    cfg = SamplerConfig(thread_num=2, chunk_size=2)
+    r1 = engine.run(spec, cfg)
+    engine.compiled.cache_clear()   # force a re-plan → disk cache hit
+    r2 = engine.run(spec, cfg)
+    assert r1.noshare_dense.tolist() == r2.noshare_dense.tolist()
+    assert r1.share_raw == r2.share_raw
+    assert _entries(plan_cache_dir), "the plan artifact must be cached"
+    engine.compiled.cache_clear()
+
+
+# ---------------------------------------------------------------------------
+# engine serving surface: dispatch keys + tenant demux
+
+
+def test_dispatch_key_identity():
+    spec = REGISTRY["gemm"](16)
+    cfg = SamplerConfig(thread_num=2)
+    k = engine.dispatch_key(spec, cfg, 64, None)
+    assert k == engine.dispatch_key(REGISTRY["gemm"](16), cfg, 64, None)
+    assert k != engine.dispatch_key(spec, cfg, 64, 4096)
+    assert k != engine.dispatch_key(spec, SamplerConfig(thread_num=4),
+                                    64, None)
+    assert k != engine.dispatch_key(REGISTRY["gemm"](12), cfg, 64, None)
+    # cache_kb is post-dispatch only: it must not split dispatch groups
+    assert k == engine.dispatch_key(
+        spec, SamplerConfig(thread_num=2, cache_kb=512), 64, None)
+    hash(k)   # usable as a grouping dict key
+
+
+def test_tenant_view_isolation():
+    spec = REGISTRY["gemm"](13)
+    cfg = SamplerConfig(thread_num=2, chunk_size=2)
+    res = engine.run(spec, cfg)
+    a, b = res.tenant_view(), res.tenant_view()
+    orig_hist = res.noshare_dense.copy()
+    orig_share = [dict(d) for d in res.share_raw]
+    a.noshare_dense[:] = -7
+    a.share_raw[0][999999] = 42.0
+    assert b.noshare_dense.tolist() == orig_hist.tolist()
+    assert b.share_raw == orig_share
+    assert res.noshare_dense.tolist() == orig_hist.tolist()
+    assert res.share_raw == orig_share
+
+
+def test_tenant_view_preserves_stamps():
+    spec = REGISTRY["gemm"](13)
+    cfg = SamplerConfig(thread_num=2, chunk_size=2)
+    res = engine.run(spec, cfg)
+    res.degradations = ("shrink_window",)
+    v = res.tenant_view()
+    assert v.degradations == ("shrink_window",)
+    assert v.max_iteration_count == res.max_iteration_count
+    assert v.share_ratio == res.share_ratio
+
+
+def test_batched_equals_solo_bit_identical():
+    """The whole coalescing contract in one assertion: one dispatch's
+    demuxed views equal K independent runs, bit for bit."""
+    from pluss import cri
+
+    spec = REGISTRY["mvt"](12)
+    cfg = SamplerConfig(thread_num=2, chunk_size=2)
+    shared = engine.run(spec, cfg)
+    views = [shared.tenant_view() for _ in range(3)]
+    solo = engine.run(spec, cfg)
+    for v in views:
+        assert v.noshare_dense.tolist() == solo.noshare_dense.tolist()
+        assert v.share_raw == solo.share_raw
+        ri_v = cri.distribute(v.noshare_list(), v.share_list(),
+                              cfg.thread_num)
+        ri_s = cri.distribute(solo.noshare_list(), solo.share_list(),
+                              cfg.thread_num)
+        assert ri_v == ri_s

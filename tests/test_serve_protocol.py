@@ -1,0 +1,279 @@
+"""Serving protocol: request parsing, the admission gate, spec codec,
+response shaping, batch keys, and the SLO latency reservoir."""
+
+import pytest
+
+import tests.conftest  # noqa: F401  (CPU platform + x64)
+from pluss.config import SHARE_CAP, SamplerConfig
+from pluss.models import REGISTRY
+from pluss.obs import LatencyReservoir
+from pluss.resilience.errors import (
+    DeadlineExceeded,
+    InvalidRequest,
+    Overloaded,
+)
+from pluss.serve.protocol import (
+    error_response,
+    parse_request,
+    result_payload,
+    spec_from_json,
+    spec_to_json,
+)
+
+
+# ---------------------------------------------------------------------------
+# inline spec codec
+
+
+@pytest.mark.parametrize("model,n", [
+    ("gemm", 16), ("mvt", 12), ("syrk_tri", 8), ("cholesky", 8),
+    ("trmm", 8), ("fdtd2d", 8),
+])
+def test_spec_json_round_trip(model, n):
+    """Encode → decode is the identity across the structural variety of
+    the registry (rectangular, triangular, quad-contract, varying-start,
+    multi-nest)."""
+    spec = REGISTRY[model](n)
+    assert spec_from_json(spec_to_json(spec)) == spec
+
+
+@pytest.mark.parametrize("mutate,what", [
+    (lambda d: d.pop("name"), "missing name"),
+    (lambda d: d.update(arrays=[["A", 0]]), "zero-element array"),
+    (lambda d: d.update(arrays="A"), "arrays not a list"),
+    (lambda d: d.update(nests=[]), "empty nests"),
+    (lambda d: d["nests"][0].pop("trip"), "loop without trip"),
+    (lambda d: d["nests"][0].update(body=[]), "empty body"),
+    (lambda d: d["nests"][0].update(trip="x"), "non-integer trip"),
+    (lambda d: d["nests"][0]["body"].append({"x": 1}),
+     "item neither loop nor ref"),
+])
+def test_spec_json_malformed(mutate, what):
+    doc = spec_to_json(REGISTRY["gemm"](8))
+    mutate(doc)
+    with pytest.raises(InvalidRequest):
+        spec_from_json(doc)
+
+
+def test_spec_json_ref_field_validation():
+    doc = spec_to_json(REGISTRY["gemm"](8))
+    # walk to the first ref and corrupt its addr_terms
+    loop = doc["nests"][0]
+    while "body" in loop and "body" in loop["body"][0]:
+        loop = loop["body"][0]
+    ref = next(b for b in loop["body"] if "array" in b)
+    ref["addr_terms"] = [[0, "x"]]
+    with pytest.raises(InvalidRequest):
+        spec_from_json(doc)
+
+
+# ---------------------------------------------------------------------------
+# parse_request / admission
+
+
+def test_parse_model_request_defaults():
+    r = parse_request({"model": "gemm", "n": 16})
+    assert r.kind == "spec" and r.spec.name == "gemm16"
+    assert r.cfg == SamplerConfig()
+    assert r.share_cap == SHARE_CAP and r.window is None
+    assert r.output == "mrc" and r.deadline is None
+    assert not r.expired()
+
+
+def test_parse_request_schedule_knobs():
+    r = parse_request({"model": "mvt", "n": 12, "threads": 2, "chunk": 3,
+                       "ds": 4, "cls": 32, "output": "both",
+                       "share_cap": 64, "window": 4096})
+    assert r.cfg == SamplerConfig(thread_num=2, chunk_size=3, ds=4, cls=32)
+    assert (r.share_cap, r.window, r.output) == (64, 4096, "both")
+
+
+def test_parse_inline_spec_request():
+    doc = spec_to_json(REGISTRY["gemm"](13))
+    doc["name"] = "tenant_custom"
+    r = parse_request({"spec": doc, "threads": 2})
+    assert r.kind == "spec" and r.spec.name == "tenant_custom"
+
+
+def test_parse_request_id_echo_and_anon():
+    assert parse_request({"id": 7, "model": "gemm", "n": 8}).id == "7"
+    anon = parse_request({"model": "gemm", "n": 8}).id
+    assert anon.startswith("anon-")
+
+
+@pytest.mark.parametrize("obj,why", [
+    ([], "not an object"),
+    ({}, "no selector"),
+    ({"model": "gemm", "trace": "/x"}, "two selectors"),
+    ({"model": "no_such_model"}, "unknown model"),
+    ({"model": "gemm", "n": -4}, "bad n rejected by the builder"),
+    ({"model": "gemm", "threads": 0}, "bad threads"),
+    ({"model": "gemm", "output": "csv"}, "bad output"),
+    ({"model": "gemm", "deadline_ms": -1}, "bad deadline"),
+    ({"model": "gemm", "deadline_ms": True}, "bool deadline"),
+    ({"trace": "/no/such/file.bin"}, "missing trace file"),
+    ({"trace": "/tmp", "fmt": "yaml"}, "bad trace fmt"),
+    ({"sleep_ms": 10_000_000}, "sleep beyond the cap"),
+])
+def test_parse_request_rejections(obj, why):
+    with pytest.raises(InvalidRequest):
+        parse_request(obj)
+
+
+def test_parse_request_deadline_stamped():
+    r = parse_request({"model": "gemm", "n": 8, "deadline_ms": 10_000})
+    rem = r.remaining_s()
+    assert rem is not None and 8.0 < rem <= 10.0
+    r2 = parse_request({"model": "gemm", "n": 8},
+                       default_deadline_ms=5_000)
+    assert 4.0 < r2.remaining_s() <= 5.0
+
+
+def test_admission_gate_rejects_analyzer_errors():
+    """A spec the PR-1 analyzer flags with ERROR diagnostics is refused
+    at admission, with the findings attached as data."""
+    # an out-of-bounds read: 1 array element, refs walk 8 — the bounds
+    # prover rejects this class (the fdtd2d bug's shape)
+    bad = {
+        "name": "oob", "arrays": [["A", 1]],
+        "nests": [{"trip": 8, "body": [
+            {"name": "A1", "array": "A", "addr_terms": [[0, 1]]}]}],
+    }
+    with pytest.raises(InvalidRequest) as ei:
+        parse_request({"spec": bad, "threads": 2})
+    assert ei.value.diagnostics, "analyzer findings must be attached"
+    assert all(d["severity"] == "ERROR" for d in ei.value.diagnostics)
+
+
+def test_admission_size_bound(monkeypatch):
+    monkeypatch.setenv("PLUSS_SERVE_MAX_REFS", "1000")
+    with pytest.raises(InvalidRequest) as ei:
+        parse_request({"model": "gemm", "n": 16})   # 16^3 * 3 refs > 1000
+    assert "PLUSS_SERVE_MAX_REFS" in str(ei.value)
+    parse_request({"model": "gemm", "n": 4})        # under the bound: fine
+
+
+def test_parse_sleep_request():
+    r = parse_request({"sleep_ms": 25})
+    assert r.kind == "sleep" and r.sleep_ms == 25
+    # sleep keys never coalesce
+    r2 = parse_request({"sleep_ms": 25})
+    assert r.batch_key() != r2.batch_key()
+
+
+# ---------------------------------------------------------------------------
+# batch keys
+
+
+def test_batch_key_coalesces_equal_plans():
+    a = parse_request({"model": "gemm", "n": 16, "threads": 2})
+    b = parse_request({"model": "gemm", "n": 16, "threads": 2,
+                       "output": "histogram", "deadline_ms": 50,
+                       "id": "zzz"})
+    assert a.batch_key() == b.batch_key(), \
+        "output/deadline/id are demux concerns, not dispatch concerns"
+
+
+@pytest.mark.parametrize("delta", [
+    {"n": 12}, {"threads": 4}, {"chunk": 2}, {"cls": 32},
+    {"window": 4096}, {"share_cap": 64}, {"model": "mvt", "n": 16},
+])
+def test_batch_key_separates_different_plans(delta):
+    base = {"model": "gemm", "n": 16, "threads": 2}
+    assert parse_request(base).batch_key() != \
+        parse_request({**base, **delta}).batch_key()
+
+
+def test_batch_key_ignores_cache_kb():
+    """cache_kb only steers the post-dispatch AET/MRC conversion: two
+    requests differing in cache size alone must SHARE the dispatch and
+    diverge at demux (result_payload shapes with each request's cfg)."""
+    a = parse_request({"model": "gemm", "n": 16, "cache_kb": 2560})
+    b = parse_request({"model": "gemm", "n": 16, "cache_kb": 512})
+    assert a.batch_key() == b.batch_key()
+    assert a.cfg.cache_kb != b.cfg.cache_kb
+
+
+def test_batch_key_trace_requests(tmp_path):
+    import numpy as np
+
+    p1, p2 = tmp_path / "a.bin", tmp_path / "b.bin"
+    for p in (p1, p2):
+        np.arange(64, dtype="<u8").tofile(p)
+    a = parse_request({"trace": str(p1)})
+    b = parse_request({"trace": str(p1), "output": "both"})
+    c = parse_request({"trace": str(p2)})
+    assert a.batch_key() == b.batch_key() != c.batch_key()
+
+
+# ---------------------------------------------------------------------------
+# responses
+
+
+def test_error_response_taxonomy_bits():
+    doc = error_response("r1", Overloaded("full", site="serve.admission"))
+    assert doc == {"id": "r1", "ok": False, "error": {
+        "type": "Overloaded", "message": "[serve.admission] full",
+        "retryable": True, "degradable": False}}
+    doc = error_response(None, DeadlineExceeded("late"))
+    assert doc["error"]["type"] == "DeadlineExceeded"
+    assert not doc["error"]["retryable"]
+    # non-Pluss errors are wrapped, never raw
+    doc = error_response("x", RuntimeError("boom"))
+    assert doc["error"]["type"] == "InternalError"
+    diag = InvalidRequest("bad", diagnostics=({"code": "PL201"},))
+    assert error_response("y", diag)["error"]["diagnostics"] == \
+        [{"code": "PL201"}]
+
+
+def test_result_payload_output_shaping():
+    ri = {-1: 3.0, 4: 10.0, 64: 2.0}
+    cfg = SamplerConfig()
+    req = parse_request({"model": "gemm", "n": 8, "output": "mrc"})
+    p = result_payload(req, ri, cfg)
+    assert "mrc" in p and "histogram" not in p
+    req.output = "histogram"
+    p = result_payload(req, ri, cfg)
+    assert p["histogram"] == {"-1": 3.0, "4": 10.0, "64": 2.0}
+    req.output = "both"
+    p = result_payload(req, ri, cfg)
+    assert set(p) == {"mrc", "histogram"}
+    # the mrc matches the direct pipeline
+    from pluss import mrc as mrc_mod
+
+    expect = [[int(c), float(m)]
+              for c, m in mrc_mod.dedup_lines(mrc_mod.aet_mrc(ri, cfg))]
+    assert p["mrc"] == expect
+
+
+# ---------------------------------------------------------------------------
+# SLO reservoir
+
+
+def test_latency_reservoir_quantiles():
+    r = LatencyReservoir(capacity=100)
+    assert r.quantile(0.5) is None
+    for v in range(1, 101):
+        r.add(float(v))
+    assert r.count == 100
+    assert r.quantile(0.0) == 1.0
+    assert r.quantile(1.0) == 100.0
+    assert 49.0 <= r.quantile(0.5) <= 52.0
+    assert 97.0 <= r.quantile(0.99) <= 100.0
+
+
+def test_latency_reservoir_slides():
+    r = LatencyReservoir(capacity=10)
+    for v in range(1000):
+        r.add(float(v))
+    # only the last 10 samples remain
+    assert r.quantile(0.0) >= 990.0
+    assert r.count == 1000
+
+
+def test_latency_reservoir_validation():
+    with pytest.raises(ValueError):
+        LatencyReservoir(capacity=0)
+    r = LatencyReservoir()
+    with pytest.raises(ValueError):
+        r.quantile(1.5)

@@ -1,0 +1,381 @@
+"""End-to-end `pluss serve` daemon tests: in-process servers on unix
+sockets / TCP, mixed-request serving bit-compared against solo runs,
+shared-dispatch coalescing, typed shedding, per-request resilience
+isolation (a degraded request never corrupts a neighbor), deadlines,
+drain-and-stop, the serve SLO telemetry block, and the heartbeat
+long-poll exporter."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (CPU platform + x64)
+from pluss import cri, engine, mrc, obs
+from pluss import trace as trace_mod
+from pluss.config import SamplerConfig
+from pluss.models import REGISTRY
+from pluss.resilience import FaultPlan
+from pluss.resilience import faults
+from pluss.serve import Client, ServeConfig, Server
+
+
+@pytest.fixture
+def server_factory(tmp_path):
+    """Builds in-process servers on throwaway unix sockets; always shuts
+    them down (daemon threads must not leak across tests)."""
+    servers = []
+    counter = [0]
+
+    def build(**cfg_kw) -> Server:
+        counter[0] += 1
+        sock = str(tmp_path / f"s{counter[0]}.sock")
+        srv = Server(socket_path=sock, config=ServeConfig(**cfg_kw))
+        srv.start()
+        servers.append(srv)
+        return srv
+
+    yield build
+    for srv in servers:
+        srv.shutdown(drain_timeout_s=30)
+
+
+@pytest.fixture
+def clean_faults():
+    yield
+    faults.install(None)
+
+
+def solo_spec(model, n, threads=2, chunk=2):
+    cfg = SamplerConfig(thread_num=threads, chunk_size=chunk)
+    res = engine.run(REGISTRY[model](n), cfg)
+    ri = cri.distribute(res.noshare_list(), res.share_list(),
+                        cfg.thread_num)
+    return {"mrc": [[int(c), float(m)]
+                    for c, m in mrc.dedup_lines(mrc.aet_mrc(ri, cfg))],
+            "histogram": {str(int(k)): float(v)
+                          for k, v in sorted(ri.items())}}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end correctness
+
+
+def test_mixed_requests_match_solo(server_factory, tmp_path):
+    srv = server_factory(max_batch=8, max_delay_ms=10)
+    trace_path = tmp_path / "refs.bin"
+    rng = np.random.default_rng(3)
+    rng.integers(0, 512, 4096).astype("<u8").tofile(trace_path)
+    with Client(srv.socket_path) as c:
+        rs = c.request_many([
+            {"model": "gemm", "n": 16, "threads": 2, "chunk": 2,
+             "output": "both"},
+            {"model": "mvt", "n": 12, "threads": 2, "chunk": 2,
+             "output": "both"},
+            {"trace": str(trace_path), "output": "both"},
+        ])
+    assert all(r["ok"] for r in rs)
+    assert rs[0]["mrc"] == solo_spec("gemm", 16)["mrc"]
+    assert rs[0]["histogram"] == solo_spec("gemm", 16)["histogram"]
+    assert rs[1]["mrc"] == solo_spec("mvt", 12)["mrc"]
+    # trace solo
+    rep = trace_mod.replay_file(str(trace_path), "u64", cls=64)
+    cfg = SamplerConfig()
+    ri = rep.histogram()
+    assert rs[2]["histogram"] == {str(int(k)): float(v)
+                                  for k, v in sorted(ri.items())}
+    assert rs[2]["mrc"] == [[int(c), float(m)] for c, m in
+                            mrc.dedup_lines(mrc.aet_mrc(ri, cfg))]
+    assert rs[2]["refs"] == 4096
+
+
+def test_inline_spec_request(server_factory):
+    from pluss.serve.protocol import spec_to_json
+
+    srv = server_factory(max_batch=4)
+    doc = spec_to_json(REGISTRY["gemm"](13))
+    doc["name"] = "tenant13"
+    with Client(srv.socket_path) as c:
+        r = c.request({"spec": doc, "threads": 2, "chunk": 2,
+                       "output": "both"})
+    assert r["ok"] and r["model"] == "tenant13"
+    assert r["histogram"] == solo_spec("gemm", 13)["histogram"]
+
+
+def test_coalescing_shares_one_dispatch(server_factory):
+    """Identical requests queued behind a hold come back from ONE shared
+    dispatch (``batched`` > 1), bit-identical to each other."""
+    srv = server_factory(max_batch=8, max_delay_ms=10, max_queue=32)
+    with Client(srv.socket_path) as c:
+        hold = c.send({"sleep_ms": 400})
+        time.sleep(0.1)   # the hold must reach the device loop first
+        ids = [c.send({"model": "gemm", "n": 16, "threads": 2,
+                       "chunk": 2}) for _ in range(5)]
+        rs = [c.recv(i) for i in ids]
+        c.recv(hold)
+    assert all(r["ok"] for r in rs)
+    assert {r["batched"] for r in rs} == {5}, \
+        "queued compatible requests must coalesce onto one dispatch"
+    assert len({json.dumps(r["mrc"]) for r in rs}) == 1
+
+
+def test_incompatible_requests_not_coalesced(server_factory):
+    srv = server_factory(max_batch=8, max_delay_ms=5, max_queue=32)
+    with Client(srv.socket_path) as c:
+        hold = c.send({"sleep_ms": 300})
+        time.sleep(0.1)
+        a = c.send({"model": "gemm", "n": 16, "threads": 2, "chunk": 2})
+        b = c.send({"model": "gemm", "n": 16, "threads": 4, "chunk": 2})
+        ra, rb = c.recv(a), c.recv(b)
+        c.recv(hold)
+    assert ra["ok"] and rb["ok"]
+    assert ra["batched"] == 1 and rb["batched"] == 1, \
+        "different schedules must not share a dispatch"
+
+
+# ---------------------------------------------------------------------------
+# admission / shedding / deadlines
+
+
+def test_overload_sheds_with_typed_error(server_factory):
+    srv = server_factory(max_queue=2, max_batch=1, max_delay_ms=0)
+    with Client(srv.socket_path) as c:
+        hold = c.send({"sleep_ms": 500})
+        time.sleep(0.1)
+        ids = [c.send({"model": "gemm", "n": 16, "threads": 2,
+                       "chunk": 2}) for _ in range(6)]
+        rs = [c.recv(i) for i in ids]
+        c.recv(hold)
+    shed = [r for r in rs if not r["ok"]]
+    served = [r for r in rs if r["ok"]]
+    assert shed, "a burst past max_queue must shed"
+    assert all(r["error"]["type"] == "Overloaded" and
+               r["error"]["retryable"] for r in shed)
+    assert len(served) <= 2 + 1   # at most the queue depth (+1 in-flight)
+
+
+def test_deadline_exceeded_while_queued(server_factory):
+    srv = server_factory(max_queue=8, max_batch=1, max_delay_ms=0)
+    with Client(srv.socket_path) as c:
+        hold = c.send({"sleep_ms": 400})
+        time.sleep(0.1)
+        rid = c.send({"model": "gemm", "n": 16, "threads": 2,
+                      "chunk": 2, "deadline_ms": 50})
+        r = c.recv(rid)
+        c.recv(hold)
+    assert not r["ok"]
+    assert r["error"]["type"] == "DeadlineExceeded"
+
+
+def test_invalid_requests_get_typed_errors(server_factory):
+    srv = server_factory()
+    with Client(srv.socket_path) as c:
+        r = c.request({"model": "no_such_model", "id": "x"})
+        assert not r["ok"] and r["error"]["type"] == "InvalidRequest"
+        # raw garbage on the wire
+        c._sock.sendall(b"this is not json\n")
+        raw = json.loads(c._rfile.readline())
+        assert not raw["ok"] and raw["error"]["type"] == "InvalidRequest"
+        # the connection survives both
+        assert c.request({"op": "ping"})["ok"]
+
+
+def test_analyzer_gate_rejects_with_diagnostics(server_factory):
+    srv = server_factory()
+    bad = {"name": "oob", "arrays": [["A", 1]],
+           "nests": [{"trip": 8, "body": [
+               {"name": "A1", "array": "A", "addr_terms": [[0, 1]]}]}]}
+    with Client(srv.socket_path) as c:
+        r = c.request({"spec": bad, "threads": 2})
+    assert not r["ok"] and r["error"]["type"] == "InvalidRequest"
+    assert r["error"]["diagnostics"], "analyzer findings must reach the client"
+
+
+# ---------------------------------------------------------------------------
+# per-request resilience isolation
+
+
+def test_degraded_request_isolated_from_neighbors(server_factory,
+                                                  clean_faults):
+    """The acceptance pin: an injected per-request fault rides the serve
+    ladder; the degraded request AND its concurrent neighbors all come
+    back bit-identical to solo runs."""
+    solo_a = solo_spec("gemm", 16)
+    solo_b = solo_spec("mvt", 12)
+    srv = server_factory(max_batch=8, max_delay_ms=5, max_queue=32)
+    faults.install(FaultPlan.parse("oom@1"))
+    try:
+        with Client(srv.socket_path) as c:
+            hold = c.send({"sleep_ms": 300})
+            time.sleep(0.1)
+            ids_a = [c.send({"model": "gemm", "n": 16, "threads": 2,
+                             "chunk": 2, "output": "both"})
+                     for _ in range(2)]
+            id_b = c.send({"model": "mvt", "n": 12, "threads": 2,
+                           "chunk": 2, "output": "both"})
+            rs_a = [c.recv(i) for i in ids_a]
+            rb = c.recv(id_b)
+            c.recv(hold)
+    finally:
+        faults.install(None)
+    assert all(r["ok"] for r in rs_a) and rb["ok"]
+    # the first dispatched batch ate the injected OOM and degraded
+    assert any(r.get("degradations") for r in rs_a + [rb]), \
+        "the injected fault must surface as a ladder degradation stamp"
+    for r in rs_a:
+        assert r["histogram"] == solo_a["histogram"], \
+            "a degraded batch must stay bit-identical to the solo run"
+        assert r["mrc"] == solo_a["mrc"]
+    assert rb["histogram"] == solo_b["histogram"], \
+        "a neighbor of a degraded request must be untouched"
+    assert rb["mrc"] == solo_b["mrc"]
+
+
+def test_serve_ladder_never_pins_cpu():
+    """The serve rung set must exclude the process-pinning cpu_fallback
+    (one tenant's failure must not degrade every later tenant)."""
+    from pluss.resilience.ladder import LADDER, SERVE_LADDER
+    from pluss.serve.server import SERVE_TRACE_LADDER
+
+    assert "cpu_fallback" not in SERVE_LADDER
+    assert "cpu_fallback" not in SERVE_TRACE_LADDER
+    assert set(SERVE_LADDER) <= set(LADDER), \
+        "serve rungs must be known rungs of the default ladder"
+
+
+# ---------------------------------------------------------------------------
+# control surface, drain, TCP
+
+
+def test_control_ops(server_factory):
+    srv = server_factory()
+    with Client(srv.socket_path) as c:
+        assert c.request({"op": "ping"})["ok"]
+        st = c.request({"op": "stats"})
+        assert st["ok"] and "queue_depth" in st
+        r = c.request({"op": "nope"})
+        assert not r["ok"] and r["error"]["type"] == "InvalidRequest"
+
+
+def test_drain_answers_queued_then_stops(server_factory):
+    srv = server_factory(max_batch=1, max_delay_ms=0, max_queue=16)
+    with Client(srv.socket_path) as c:
+        hold = c.send({"sleep_ms": 300})
+        time.sleep(0.1)
+        rid = c.send({"model": "gemm", "n": 16, "threads": 2, "chunk": 2})
+        time.sleep(0.1)   # the request must be QUEUED before the drain
+        t = threading.Thread(target=srv.shutdown, daemon=True)
+        t.start()
+        r = c.recv(rid)       # queued work is answered during the drain
+        c.recv(hold)
+        t.join(timeout=30)
+    assert r["ok"], "drain must answer queued requests, not drop them"
+    assert srv._drained.is_set()
+    srv.shutdown()   # idempotent
+
+
+def test_tcp_port_mode():
+    srv = Server(port=0, config=ServeConfig(max_batch=2))
+    srv.start()
+    try:
+        assert srv.port != 0
+        with Client(f"127.0.0.1:{srv.port}") as c:
+            assert c.request({"op": "ping"})["ok"]
+            r = c.request({"model": "gemm", "n": 13, "threads": 2,
+                           "chunk": 2})
+            assert r["ok"] and r["mrc"]
+    finally:
+        srv.shutdown()
+
+
+def test_server_ctor_validation(tmp_path):
+    with pytest.raises(ValueError):
+        Server()
+    with pytest.raises(ValueError):
+        Server(socket_path=str(tmp_path / "x.sock"), port=1234)
+
+
+# ---------------------------------------------------------------------------
+# SLO telemetry + exporters
+
+
+def test_serve_slo_telemetry_block(server_factory, tmp_path):
+    """A served stream carries the serve counters/gauges, passes the
+    schema check, and renders the serve SLO block in `pluss stats`."""
+    import io
+
+    from pluss.obs import stats as stats_mod
+
+    sink = tmp_path / "tel.jsonl"
+    obs.configure(str(sink))
+    try:
+        srv = server_factory(max_batch=8, max_delay_ms=5)
+        with Client(srv.socket_path) as c:
+            for _ in range(3):
+                assert c.request({"model": "gemm", "n": 16, "threads": 2,
+                                  "chunk": 2})["ok"]
+        # quiesce BEFORE closing the sink: spans record at exit, so the
+        # last serve.batch span must close before the end record lands
+        srv.shutdown()
+        obs.flush_metrics()
+        cs, gs = obs.counters(), obs.gauges()
+    finally:
+        obs.shutdown()
+    assert cs["serve.requests"] == 3 and cs["serve.ok"] == 3
+    assert cs["serve.batches"] >= 1
+    assert "serve.p50_ms" in gs and "serve.queue_depth" in gs
+    records, problems, _ = stats_mod.load(str(sink))
+    assert not problems, problems
+    out = io.StringIO()
+    stats_mod.render(records, out)
+    text = out.getvalue()
+    assert "serve SLO:" in text
+    assert "latency p50 / p99" in text
+    assert "batches dispatched" in text
+
+
+def test_serve_breakdown_absent_without_serve_counters():
+    from pluss.obs.stats import serve_breakdown
+
+    assert serve_breakdown({"trace.h2d_s": 1.0}, {}) == []
+
+
+def test_heartbeat_longpoll_exporter(tmp_path):
+    """The PR-5 follow-up: heartbeat_age_s gauges land in the Prometheus
+    textfile on a timer from a RUNNING process, not only at shutdown."""
+    from pluss.parallel import multihost
+
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    (hb / "hb.0.json").write_text("{}")
+    prom = tmp_path / "prom.txt"
+    obs.configure(str(tmp_path / "tel.jsonl"), prom_path=str(prom))
+    try:
+        stop = multihost.start_heartbeat_exporter(str(hb), 2,
+                                                  interval_s=0.2)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if prom.exists() and "heartbeat_age_s" in prom.read_text():
+                    break
+                time.sleep(0.1)
+        finally:
+            stop()
+        text = prom.read_text()
+        assert "pluss_multihost_heartbeat_age_s_0" in text, text[:400]
+        # the missing worker 1 gauges -1 (scrapeably dead, not absent)
+        assert "pluss_multihost_heartbeat_age_s_1 -1" in text
+    finally:
+        obs.shutdown()
+
+
+def test_heartbeat_exporter_stop_is_idempotent(tmp_path):
+    from pluss.parallel import multihost
+
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    stop = multihost.start_heartbeat_exporter(str(hb), 1, interval_s=0.2)
+    stop()
+    stop()
