@@ -200,7 +200,9 @@ def emit(metric: str, refs: int, best_s: float, base_s: float | None,
     (VERDICT r5 task 4; names stay stable for round-over-round diffs).
     ``degradations`` carries the resilience ladder's stamp (empty for a
     clean run), so a degraded run is visible in the perf trajectory
-    instead of masquerading as a regression."""
+    instead of masquerading as a regression.  Spec metric lines also
+    carry ``spec_source`` (registry | dsl | c — via ``extra``, round r08
+    on) recording which authoring surface produced the measured spec."""
     vs = base_s / best_s if base_s else None
     refs_per_sec = refs / best_s
     log(f"bench: {metric} best {refs_per_sec:.3e} refs/s"
@@ -647,6 +649,35 @@ def bench_serve(n_requests: int = 48) -> None:
         }), flush=True)
 
 
+def bench_import(reps: int = 3) -> None:
+    """Frontend ingestion throughput (round r08 on): parse + lower +
+    share-span derivation + PR-1 analyzer gate for the checked-in
+    PolyBench pragma-C corpus (pluss/frontend/polybench.py), reported as
+    specs/sec.  Pure host work — a sanity rate recording that the
+    authoring path stays interactive (thousands of user-submitted nests
+    per daemon-minute), not a device metric."""
+    from pluss.frontend import polybench
+
+    specs = polybench.import_polybench()   # warmup: imports, regex, jit
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        polybench.import_polybench()
+    dt = time.perf_counter() - t0
+    n = reps * len(specs)
+    log(f"bench: frontend imported {len(specs)} polybench families x"
+        f"{reps} in {dt:.2f}s ({n / dt:.1f} specs/s)")
+    print(json.dumps({
+        "metric": "import_polybench_specs_per_sec",
+        "value": round_keep(n / dt, 3),
+        "unit": "specs/s",
+        "vs_baseline": None,
+        "path": "frontend.import(c)+lint",
+        "degradations": [],
+        "spec_source": "c",
+        "families": sorted(specs),
+    }), flush=True)
+
+
 def main() -> int:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
     # persistent XLA compilation cache: the flagship compiles cost minutes
@@ -702,11 +733,16 @@ def main() -> int:
              cached_native_s("gemm128", lambda: native_baseline_s(128)),
              path=engine.describe_path(gemm(128)),
              degradations=tuple(res.degradations),
+             spec_source="registry",
              **analysis_fields(gemm(128)))
         try:
             bench_serve(24)
         except Exception as e:
             log(f"bench: serve metric failed: {e}")
+        try:
+            bench_import()
+        except Exception as e:
+            log(f"bench: import metric failed: {e}")
         return 0
 
     # headline FIRST (round 3's record has rc=124 with this metric still
@@ -733,7 +769,7 @@ def main() -> int:
         # and must never stand between a measured flagship and its
         # emission (the rc=124 precedent) — the re-emission at the end
         # carries the stamped version
-        emit(*flagship)
+        emit(*flagship, spec_source="registry")
         flagship_extra = analysis_fields(gemm(1024))
     except Exception as e:
         log(f"bench: FLAGSHIP gemm1024 metric failed: {e}")
@@ -755,6 +791,7 @@ def main() -> int:
                  native_s_of("syrk1024", syrk(n_syrk)),
                  path=engine.describe_path(syrk(n_syrk)),
                  degradations=tuple(res.degradations),
+                 spec_source="registry",
                  **analysis_fields(syrk(n_syrk)))
         except Exception as e:  # never let an aux metric sink the record
             log(f"bench: syrk metric failed: {e}")
@@ -775,6 +812,7 @@ def main() -> int:
                  native_s_of("syrktri1024", spec_tri),
                  path=engine.describe_path(spec_tri),
                  degradations=tuple(res.degradations),
+                 spec_source="registry",
                  **analysis_fields(spec_tri))
         except Exception as e:
             log(f"bench: triangular metric failed: {e}")
@@ -806,6 +844,13 @@ def main() -> int:
             bench_serve()
         except Exception as e:
             log(f"bench: serve metric failed: {e}")
+
+    # frontend ingestion throughput (round r08 on): host-only, ~seconds
+    if budget_ok("import_polybench", 30):
+        try:
+            bench_import()
+        except Exception as e:
+            log(f"bench: import metric failed: {e}")
 
     # accuracy half of the north star (BASELINE.json: "miss-ratio-curve L2
     # error vs C++ baseline" within 1%): MRC of the TPU pipeline vs the
@@ -844,7 +889,8 @@ def main() -> int:
     # payload to the first emission — purely a record-ordering concern.
     if flagship is not None:
         log("bench: re-emitting flagship line as the record headline")
-        emit(*flagship, **flagship_extra)
+        emit(*flagship, spec_source="registry",
+             **flagship_extra)
     return 0
 
 

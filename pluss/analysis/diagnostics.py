@@ -22,6 +22,11 @@ Code families mirror the analyzer's four passes:
 - ``PL5xx`` falseshare (:mod:`pluss.analysis.falseshare`): line-granular
   cross-thread false-sharing detection (also ``analyze``-only — it needs
   the machine model's element and line widths).
+- ``PL6xx`` frontend (:mod:`pluss.frontend`): authoring-time rejections
+  from the loop-nest DSL and the pragma-C parser (non-affine constructs,
+  out-of-grammar steps, missing pragmas, malformed source) — emitted
+  BEFORE a spec exists, so they carry source locations instead of tree
+  paths.  PL609 wraps an analyzer rejection of a frontend-derived spec.
 
 Severity semantics: ERROR means the spec is wrong (out-of-bounds access,
 undeclared array, contract violation) — ``pluss lint`` exits nonzero.
@@ -84,6 +89,22 @@ CODES: dict[str, tuple[str, str]] = {
     "PL405": ("contract", "outside the quadratic position contract"),
     "PL406": ("contract", "duplicate reference name inside one nest"),
     "PL407": ("contract", "spec rejected by flatten"),
+    "PL601": ("frontend", "non-affine expression (subscript, bound, or "
+                          "operator outside the affine grammar)"),
+    "PL602": ("frontend", "loop step outside the frontend grammar"),
+    "PL603": ("frontend", "parallel marker missing on a top-level loop "
+                          "nest (or placed on an inner loop)"),
+    "PL604": ("frontend", "loop variable shadows an enclosing loop "
+                          "variable"),
+    "PL605": ("frontend", "malformed source (tokenizer/parser rejection)"),
+    "PL606": ("frontend", "reference to an undeclared array or wrong "
+                          "subscript arity"),
+    "PL607": ("frontend", "loop bound/start outside the lowerable affine "
+                          "contract"),
+    "PL608": ("frontend", "authoring-API misuse (ref outside a loop, "
+                          "duplicate array, out-of-scope index)"),
+    "PL609": ("frontend", "frontend-derived spec rejected by the static "
+                          "analyzer"),
 }
 
 

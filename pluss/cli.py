@@ -188,6 +188,161 @@ def _verify_spec(spec, cfg: SamplerConfig, out_err) -> int:
     return analysis.error_count(diags)
 
 
+def _run_spec_block(spec, cfg: SamplerConfig, args, out):
+    """One acc-style block (timed vmap run + the three histogram dumps)
+    for a frontend-derived or file-loaded spec — the same diffable
+    format as `pluss acc`."""
+    step = _sampler_of("vmap", spec, cfg, args.share_cap, args.window,
+                       args.start_point)
+    step()  # warmup: exclude compilation from the timed region
+    dt, res, ri = _timed(step, args.profile)
+    acc_block(f"TPU IMPORT {spec.name}", dt, res.noshare_list(),
+              res.share_list(), ri, res.max_iteration_count, out)
+    return res, ri
+
+
+def _check_against_model(args, cfg: SamplerConfig, res, ri, spec,
+                         ref) -> int:
+    """The import bit-identity gate: the registry model at --n, same
+    schedule, must produce byte-identical histograms and MRC.  ``ref``
+    is the reference ``(res, curve)`` — engine run AND its MRC computed
+    ONCE by the caller, not once per derived spec."""
+    import numpy as np
+
+    ref_res, ref_curve = ref
+    same_hist = (res.noshare_list() == ref_res.noshare_list()
+                 and res.share_list() == ref_res.share_list())
+    same_mrc = np.array_equal(mrc.aet_mrc(ri, cfg), ref_curve)
+    if same_hist and same_mrc:
+        print(f"pluss import: {spec.name}: histogram + MRC byte-"
+              f"identical to registry {args.check_model}({args.n})",
+              file=sys.stderr)
+        return 0
+    print(f"pluss import: {spec.name}: DIVERGES from registry "
+          f"{args.check_model}({args.n}) "
+          f"(histograms {'==' if same_hist else '!='}, "
+          f"MRC {'==' if same_mrc else '!='})", file=sys.stderr)
+    return 1
+
+
+def _import_main(args, p, out, setup_platform) -> int:
+    """``pluss import <file.py|file.c> [--run|--json|--register]``."""
+    import json as json_mod
+
+    from pluss import analysis, frontend, spec_codec
+
+    if not args.target:
+        p.error("import mode requires a source file (.py DSL or "
+                ".c pragma-C)")
+    if args.check_model is not None and args.check_model not in REGISTRY:
+        p.error(f"--check-model: unknown model {args.check_model!r}")
+    try:
+        # --verify upgrades the admission gate to the schedule-aware
+        # PR-3 analysis under the CLI's own (--threads, --chunk)
+        gate_cfg = SamplerConfig(thread_num=args.threads,
+                                 chunk_size=args.chunk) \
+            if args.verify else None
+        pairs = frontend.import_path(args.target, gate_cfg)
+    except frontend.FrontendError as e:
+        # typed rejection: PL6xx grammar findings, or the analyzer's own
+        # diagnostics when the gate refused a grammatical source
+        for d in e.diagnostics:
+            print(d.format(), file=sys.stderr)
+        print(f"pluss import: {args.target}: rejected ({e.code})",
+              file=sys.stderr)
+        return 1
+    for spec, diags in pairs:
+        text = analysis.format_text(diags)
+        if text:      # warnings only — errors raised above
+            print(text, file=sys.stderr)
+    print(f"pluss import: {args.target}: {len(pairs)} spec(s) derived, "
+          f"analyzer-clean ({', '.join(s.name for s, _ in pairs)})",
+          file=sys.stderr)
+    if args.json:
+        docs = [spec_codec.spec_to_json(s) for s, _ in pairs]
+        out.write(json_mod.dumps(docs[0] if len(docs) == 1 else docs,
+                                 indent=1) + "\n")
+    if args.register:
+        import os
+
+        os.makedirs(args.registry_dir, exist_ok=True)
+        for spec, _ in pairs:
+            path = os.path.join(args.registry_dir, f"{spec.name}.json")
+            with open(path, "w") as f:
+                f.write(spec_codec.dump_spec(spec) + "\n")
+            print(f"pluss import: registered {spec.name} -> {path} "
+                  f"(PLUSS_SPEC_DIR={args.registry_dir} serves it as a "
+                  "registry model)", file=sys.stderr)
+    rc = 0
+    if args.run or args.check_model:
+        setup_platform()
+        run_cfg = SamplerConfig(thread_num=args.threads,
+                                chunk_size=args.chunk)
+        ref = None
+        if args.check_model:   # the reference runs once, not per spec
+            ref_res, ref_ri = _sampler_of(
+                "vmap", REGISTRY[args.check_model](args.n), run_cfg,
+                args.share_cap, args.window, args.start_point)()
+            ref = (ref_res, mrc.aet_mrc(ref_ri, run_cfg))
+        for spec, _ in pairs:
+            res, ri = _run_spec_block(spec, run_cfg, args, out)
+            if ref is not None:
+                rc |= _check_against_model(args, run_cfg, res, ri, spec,
+                                           ref)
+    return rc
+
+
+def _spec_main(args, p, out, setup_platform) -> int:
+    """``pluss spec dump <model>`` / ``pluss spec load <file.json>``."""
+    from pluss import analysis, spec_codec
+    from pluss.resilience.errors import InvalidRequest
+
+    verb = args.target
+    if verb not in ("dump", "load"):
+        p.error("spec mode: `pluss spec dump <model>` or "
+                "`pluss spec load <file.json> [--run]`")
+    if verb == "dump":
+        if not args.arg2:
+            # an omitted model must not silently dump the --model
+            # default (the `pluss lint gemm` stray-positional class)
+            p.error("spec dump requires a model name "
+                    "(`pluss spec dump <model> [--n N]`)")
+        model = args.arg2
+        if model not in REGISTRY:
+            p.error(f"spec dump: unknown model {model!r}")
+        out.write(spec_codec.dump_spec(REGISTRY[model](args.n)) + "\n")
+        return 0
+    if not args.arg2:
+        p.error("spec load requires a spec JSON file path")
+    try:
+        spec = spec_codec.load_spec_file(args.arg2)
+    except InvalidRequest as e:
+        print(f"pluss spec load: {e}", file=sys.stderr)
+        return 1
+    # loaded specs pass the same lint gate as served/imported ones
+    diags = analysis.with_model(analysis.lint_spec(spec), spec.name)
+    text = analysis.format_text(diags)
+    if text:
+        print(text, file=sys.stderr)
+    if analysis.error_count(diags):
+        print(f"pluss spec load: {spec.name} rejected by the static "
+              "analyzer", file=sys.stderr)
+        return 1
+    if args.run:
+        setup_platform()
+        cfg = SamplerConfig(thread_num=args.threads,
+                            chunk_size=args.chunk)
+        _run_spec_block(spec, cfg, args, out)
+    else:
+        from pluss.spec import loop_size
+
+        total = sum(loop_size(n) for n in spec.nests)
+        out.write(f"{spec.name}: {len(spec.nests)} nest(s), "
+                  f"{len(spec.arrays)} array(s), {total} accesses; "
+                  "lint clean\n")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     from pluss.utils.platform import enable_x64
 
@@ -196,10 +351,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("mode",
                    choices=("acc", "speed", "mrc", "trace", "sweep",
                             "sample", "lint", "analyze", "stats",
-                            "serve"))
+                            "serve", "import", "spec"))
     p.add_argument("target", nargs="?", default=None,
                    help="stats mode: telemetry event stream (events.jsonl) "
-                        "to aggregate")
+                        "to aggregate; import mode: the .py (DSL) or .c "
+                        "(pragma-C) source file; spec mode: dump | load")
+    p.add_argument("arg2", nargs="?", default=None,
+                   help="spec mode: the model to dump / the spec JSON "
+                        "file to load")
     p.add_argument("--check", action="store_true",
                    help="stats mode: validate the event stream against "
                         "the telemetry schema instead of rendering it "
@@ -299,6 +458,26 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--prom-refresh-s", type=float, default=5.0,
                    help="serve mode: SLO gauge + prometheus textfile "
                         "(PLUSS_PROM) refresh period")
+    p.add_argument("--run", action="store_true",
+                   help="import / spec-load mode: after the analyzer "
+                        "gate, run the derived spec through the engine "
+                        "and print the acc-style block (timing banner + "
+                        "histogram dumps)")
+    p.add_argument("--check-model", default=None, metavar="MODEL",
+                   help="import mode: also run the registry MODEL (at "
+                        "--n) and require histogram + MRC byte-identical "
+                        "to the imported spec's run — the frontend "
+                        "bit-identity gate (exit 1 on divergence)")
+    p.add_argument("--register", action="store_true",
+                   help="import mode: write each derived spec as codec "
+                        "JSON into --registry-dir; set PLUSS_SPEC_DIR to "
+                        "that directory and every pluss entry point "
+                        "(CLI --model, serve requests) sees them as "
+                        "registry models")
+    p.add_argument("--registry-dir", default=".pluss_registry",
+                   metavar="DIR",
+                   help="import --register target directory (default "
+                        ".pluss_registry)")
     p.add_argument("--start-point", type=int, default=None,
                    help="resume sampling from this parallel-loop iteration "
                         "value (the reference's setStartPoint capability)")
@@ -319,14 +498,19 @@ def main(argv: list[str] | None = None) -> int:
                         "DIR (view with tensorboard or xprof)")
     args = p.parse_args(argv)
 
-    if args.target is not None and args.mode != "stats":
-        # the optional positional exists only for `stats <events.jsonl>`;
-        # anywhere else a stray argument must stay the usage error it
-        # always was (`pluss lint gemm` would otherwise silently lint the
-        # DEFAULT model and report it clean)
+    if args.target is not None and args.mode not in ("stats", "import",
+                                                     "spec"):
+        # the optional positionals exist only for `stats <events.jsonl>`,
+        # `import <file>`, and `spec <dump|load> <what>`; anywhere else a
+        # stray argument must stay the usage error it always was
+        # (`pluss lint gemm` would otherwise silently lint the DEFAULT
+        # model and report it clean)
         p.error(f"unexpected argument {args.target!r} for mode "
-                f"{args.mode!r} (positional input is stats-mode only; "
-                "use --model/--file)")
+                f"{args.mode!r} (positional input is for stats/import/"
+                "spec modes only; use --model/--file)")
+    if args.arg2 is not None and args.mode != "spec":
+        p.error(f"unexpected argument {args.arg2!r} for mode "
+                f"{args.mode!r}")
 
     if args.mode == "stats":
         # pure host aggregation of a recorded stream: no accelerator, no
@@ -353,11 +537,12 @@ def main(argv: list[str] | None = None) -> int:
             if args.mode == "analyze" else None
         return _lint_main(args, sys.stdout, cfg)
 
-    if args.cpu:
-        from pluss.utils.platform import force_cpu
+    def setup_platform() -> None:
+        if args.cpu:
+            from pluss.utils.platform import force_cpu
 
-        force_cpu(8)
-    else:
+            force_cpu(8)
+            return
         # a wedged TPU tunnel hangs any jax op forever; probe killably and
         # degrade to the CPU backend instead of hanging the driver.  Skip
         # when the process is already pinned to CPU (tests, prior force_cpu).
@@ -369,6 +554,18 @@ def main(argv: list[str] | None = None) -> int:
             print("pluss: no usable accelerator, falling back to CPU",
                   file=sys.stderr)
             force_cpu(8)
+
+    if args.mode == "import":
+        # the authoring frontend (pluss/frontend): derive analyzer-
+        # verified specs from DSL or pragma-C source.  Device-free unless
+        # --run/--check-model asks for an engine run.
+        return _import_main(args, p, sys.stdout, setup_platform)
+
+    if args.mode == "spec":
+        # shared-codec verbs: `spec dump <model>` / `spec load <file.json>`
+        return _spec_main(args, p, sys.stdout, setup_platform)
+
+    setup_platform()
 
     if args.mode == "serve":
         # the long-lived multi-tenant prediction daemon (pluss/serve):

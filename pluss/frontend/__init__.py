@@ -1,0 +1,125 @@
+"""``pluss.frontend`` — the loop-nest AUTHORING subsystem.
+
+Two entry surfaces, one verified artifact: the Python loop-nest DSL
+(:mod:`pluss.frontend.dsl`) and the pragma-annotated-C parser
+(:mod:`pluss.frontend.cparse`) both record a small surface-independent
+IR (:mod:`pluss.frontend.ir`) that ONE normalizer
+(:mod:`pluss.frontend.lower`) turns into a
+:class:`~pluss.spec.LoopNestSpec` — and every derived spec passes the
+PR-1 lint (plus, schedule given, the PR-3 schedule-aware analysis)
+before anyone runs it.  The registry (:mod:`pluss.models`) becomes a
+test corpus; the frontend is how new nests enter the system: ``pluss
+import file.py|file.c [--run|--json|--register]`` on the CLI, the
+``{"source": ...}`` request kind through ``pluss serve``, and
+``frontend.import_polybench()`` for the checked-in PolyBench corpus
+(:mod:`pluss.frontend.polybench`).
+
+Out-of-grammar constructs raise typed ``PL6xx``
+:class:`FrontendError`\\ s (registered in the analyzer's CODES table);
+an analyzer rejection of a grammatical source raises
+:class:`FrontendRejected` with the findings attached.
+``emit_dsl(spec)`` prints any spec back as DSL source — the round-trip
+that pins the grammar covers every hand-written registry family.
+"""
+
+from __future__ import annotations
+
+from pluss.frontend.cparse import parse_c
+from pluss.frontend.dsl import (ArrayHandle, Kernel, array, collect_kernels,
+                                kernel, loop, loop_raw, read, write)
+from pluss.frontend.emit import emit_dsl
+from pluss.frontend.ir import FrontendError, FrontendRejected, LinExpr, err
+from pluss.frontend.lower import derive_spans, lower, verify_spec
+
+
+def from_c(src: str, name: str = "source"):
+    """Pragma-C text -> ONE LoopNestSpec (a file's pragma nests are one
+    workload, like the reference's ``gemm.ppcg_omp.c``).  No analyzer
+    gate — callers gate via :func:`verify_spec` (``pluss serve`` runs
+    its own memoized admission verdict)."""
+    return lower(parse_c(src, name))
+
+
+def from_py(src: str, filename: str = "<dsl>"):
+    """Execute DSL source text, collecting every kernel it records ->
+    list of LoopNestSpecs (ungated, like :func:`from_c`).  CLI-only
+    surface: this EXECUTES the text — never feed it wire input."""
+    import pluss.frontend as frontend_mod
+
+    ns = {"frontend": frontend_mod, "__name__": "__pluss_dsl__"}
+    with collect_kernels() as kernels:
+        try:
+            code = compile(src, filename, "exec")
+        except SyntaxError as e:
+            raise err("PL605", f"{filename}: not valid Python DSL "
+                               f"source: {e}") from e
+        try:
+            exec(code, ns)
+        except FrontendError:
+            raise              # already typed, with its own code
+        except Exception as e:
+            # a plain Python bug in the DSL file (NameError, ...) must
+            # still reach `pluss import` as a typed rejection, not a raw
+            # traceback; __cause__ keeps the chain for debugging
+            raise err("PL605", f"{filename}: DSL source raised "
+                               f"{type(e).__name__}: {e}") from e
+    if not kernels:
+        raise err("PL608", f"{filename}: no frontend.kernel(...) block "
+                           "finished recording")
+    # a decorated builder called N times records N identical kernels:
+    # exact duplicates collapse (the call was idempotent), but two
+    # DIFFERENT specs under one name would silently overwrite each
+    # other downstream (--register files, registry entries) — typed
+    from pluss.spec_codec import spec_to_json
+
+    out, seen = [], {}
+    for k in kernels:
+        spec = k.spec()
+        doc = spec_to_json(spec)
+        if spec.name in seen:
+            if seen[spec.name] == doc:
+                continue
+            raise err("PL608",
+                      f"{filename}: two different kernels named "
+                      f"{spec.name!r} — names must be unique per file")
+        seen[spec.name] = doc
+        out.append(spec)
+    return out
+
+
+def from_source(src: str, lang: str, name: str = "source"):
+    """Dispatch by dialect: ``c`` -> one-spec list, ``py`` -> kernels."""
+    if lang == "c":
+        return [from_c(src, name)]
+    if lang == "py":
+        return from_py(src, name)
+    raise err("PL605", f"unknown source dialect {lang!r} (c | py)")
+
+
+def import_path(path: str, cfg=None):
+    """``pluss import``'s core: read a ``.py`` or ``.c`` file, derive
+    its spec(s), and run the analyzer ADMISSION GATE on each (ERROR
+    findings raise :class:`FrontendRejected` with the findings
+    attached).  Returns ``[(spec, diagnostics), ...]``."""
+    import os
+
+    stem = os.path.splitext(os.path.basename(path))[0]
+    ext = os.path.splitext(path)[1].lower()
+    if ext not in (".c", ".py"):
+        raise err("PL605", f"{path}: unknown source extension {ext!r} "
+                           "(expected .c or .py)")
+    try:
+        with open(path) as f:
+            src = f.read()
+    except OSError as e:
+        raise err("PL605", f"cannot read {path}: {e}") from e
+    specs = from_source(src, "c" if ext == ".c" else "py", name=stem)
+    return [(spec, verify_spec(spec, cfg)) for spec in specs]
+
+
+__all__ = [
+    "ArrayHandle", "FrontendError", "FrontendRejected", "Kernel",
+    "LinExpr", "array", "collect_kernels", "derive_spans", "emit_dsl",
+    "from_c", "from_py", "from_source", "import_path", "kernel", "loop",
+    "loop_raw", "lower", "parse_c", "read", "verify_spec", "write",
+]

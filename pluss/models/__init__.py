@@ -3,6 +3,17 @@
 Each builder returns a :class:`pluss.spec.LoopNestSpec`.  ``gemm`` reproduces the
 reference's only shipped workload; the others cover the BASELINE.json configs
 (PolyBench 2mm/3mm/syrk, conv2d 3x3, stencil-3D).
+
+Since the frontend (PR 8, :mod:`pluss.frontend`) the hand-written
+registry is a TEST CORPUS, not the only ingestion path: new nests enter
+as DSL/pragma-C source through ``pluss import``, and
+``pluss import --register`` persists them as codec-JSON files that
+:func:`register_spec_dir` folds back into ``REGISTRY`` — point
+``PLUSS_SPEC_DIR`` at such a directory and every entry point (CLI
+``--model``, serve ``{"model": ...}`` requests, sweeps) sees the
+imported specs as first-class models.  File-registered specs are
+fixed-size (the size is baked into the source they were derived from);
+their builders accept and ignore the conventional ``n`` argument.
 """
 
 from pluss.models.gemm import gemm
@@ -47,11 +58,78 @@ REGISTRY = {
     "seidel2d": seidel2d,
 }
 
+def register_spec_dir(path: str, registry: dict | None = None) -> list[str]:
+    """Fold ``pluss import --register`` codec-JSON files into the
+    registry.  Returns the names added; files that fail the codec are
+    skipped with a stderr notice (a broken file must not take down every
+    entry point's import), and hand-written builders are never shadowed.
+    """
+    import os
+    import sys
+
+    reg = REGISTRY if registry is None else registry
+    added: list[str] = []
+    try:
+        entries = sorted(os.listdir(path))
+    except OSError as e:
+        print(f"pluss.models: cannot read PLUSS_SPEC_DIR {path}: {e}",
+              file=sys.stderr)
+        return added
+    for fn in entries:
+        if not fn.endswith(".json"):
+            continue
+        full = os.path.join(path, fn)
+        try:
+            from pluss.spec_codec import load_spec_file
+
+            spec = load_spec_file(full)
+        except Exception as e:  # noqa: BLE001 — typed InvalidRequest or IO
+            print(f"pluss.models: skipping {full}: {e}", file=sys.stderr)
+            continue
+        if spec.name in reg:
+            print(f"pluss.models: {full}: name {spec.name!r} already "
+                  "registered; not shadowing", file=sys.stderr)
+            continue
+        reg[spec.name] = _fixed_size_builder(spec)
+        added.append(spec.name)
+    return added
+
+
+def _fixed_size_builder(spec):
+    """Builder for a file-registered spec: fixed-size (the size is baked
+    into the source it was derived from).  A caller-supplied ``n`` is
+    accepted for interface compatibility (the CLI always passes one) but
+    NOTICED on stderr once per spec — a serve client asking for
+    {"model": "x", "n": 2048} must not silently get the baked size
+    labeled as its request."""
+    import sys
+
+    warned = []
+
+    def build(n=None):
+        if n is not None and not warned:
+            warned.append(True)
+            print(f"pluss.models: {spec.name!r} is a file-registered "
+                  f"fixed-size spec; ignoring n={n} (re-import the "
+                  "source at another size to change it)",
+                  file=sys.stderr)
+        return spec
+
+    return build
+
+
+import os as _os
+
+_spec_dir = _os.environ.get("PLUSS_SPEC_DIR")
+if _spec_dir:
+    register_spec_dir(_spec_dir)
+
+
 __all__ = [
     "gemm", "mm2", "mm3", "syrk", "syr2k", "conv2d", "stencil3d",
     "atax", "mvt", "bicg", "gesummv", "doitgen", "jacobi2d",
     "gemver", "fdtd2d", "heat3d", "syrk_triangular", "trmm", "symm",
     "covariance", "correlation", "trisolv", "durbin", "gramschmidt",
     "floyd_warshall", "cholesky", "lu", "ludcmp", "seidel2d",
-    "REGISTRY",
+    "REGISTRY", "register_spec_dir",
 ]

@@ -5,7 +5,14 @@ schema (exactly one selector per request):
 
 - ``{"model": "gemm", "n": 64, ...}`` — a registry model at a size;
 - ``{"spec": {...}, ...}`` — an inline :class:`~pluss.spec.LoopNestSpec`
-  (see :func:`spec_from_json`; :func:`spec_to_json` is its inverse);
+  (see :func:`spec_from_json`; :func:`spec_to_json` is its inverse —
+  both now live in :mod:`pluss.spec_codec` and are re-exported here);
+- ``{"source": "...", "lang": "c", ...}`` — inline pragma-annotated C
+  source (the ``gemm.ppcg_omp.c`` subset) the FRONTEND derives a spec
+  from (:mod:`pluss.frontend`), then admits through the very same
+  analyzer gate and shared-dispatch path as an inline spec.  Only the
+  ``c`` dialect is served: the Python DSL executes caller code and is a
+  CLI-only surface (``pluss import file.py``), never a wire one;
 - ``{"trace": "/path/refs.bin", "fmt": "u64", ...}`` — a packed-trace
   replay (a SERVER-side path: the daemon serves local callers, it is not
   an internet-facing file service).
@@ -42,7 +49,10 @@ import time
 
 from pluss.config import SHARE_CAP, SamplerConfig
 from pluss.resilience.errors import InvalidRequest, PlussError
-from pluss.spec import Loop, LoopNestSpec, Ref, SpecContractError, loop_size
+from pluss.spec import LoopNestSpec, SpecContractError, loop_size
+from pluss.spec_codec import spec_from_json, spec_to_json  # noqa: F401
+# ^ the codec moved to pluss.spec_codec (shared by serve, frontend, and
+#   the CLI's spec dump/load verbs); re-exported here for compatibility
 
 #: default per-request stream bound (total accesses across threads): big
 #: enough for the flagship gemm-1024 (4.3e9), small enough that one rogue
@@ -59,145 +69,6 @@ def max_serve_refs() -> int:
 
 
 # ---------------------------------------------------------------------------
-# inline spec codec
-
-
-def spec_to_json(spec: LoopNestSpec) -> dict:
-    """JSON-able dict encoding of a spec (inverse of :func:`spec_from_json`)."""
-
-    def enc_item(item):
-        if isinstance(item, Ref):
-            d = {"name": item.name, "array": item.array,
-                 "addr_terms": [list(t) for t in item.addr_terms]}
-            if item.addr_base:
-                d["addr_base"] = item.addr_base
-            if item.share_span is not None:
-                d["share_span"] = item.share_span
-            if item.is_write:
-                d["is_write"] = True
-            if item.dtype_bytes is not None:
-                d["dtype_bytes"] = item.dtype_bytes
-            return d
-        d = {"trip": item.trip, "body": [enc_item(b) for b in item.body]}
-        if item.start:
-            d["start"] = item.start
-        if item.step != 1:
-            d["step"] = item.step
-        if item.bound_coef is not None:
-            d["bound_coef"] = list(item.bound_coef)
-        if item.start_coef:
-            d["start_coef"] = item.start_coef
-        if item.bound_level:
-            d["bound_level"] = item.bound_level
-        return d
-
-    return {"name": spec.name,
-            "arrays": [[a, n] for a, n in spec.arrays],
-            "nests": [enc_item(n) for n in spec.nests]}
-
-
-def _as_int(obj, key: str, default=None, where: str = "spec"):
-    v = obj.get(key, default)
-    if v is None:
-        if default is None:
-            raise InvalidRequest(f"{where}: missing required field "
-                                 f"{key!r}", site="serve.parse")
-        v = default   # explicit null means "use the default"
-    if isinstance(v, bool) or not isinstance(v, int):
-        raise InvalidRequest(f"{where}: field {key!r} must be an integer, "
-                             f"got {v!r}", site="serve.parse")
-    return v
-
-
-def spec_from_json(obj) -> LoopNestSpec:
-    """Decode an inline spec; every malformation raises
-    :class:`InvalidRequest` (never a KeyError/TypeError leaking schema
-    internals to the connection handler)."""
-    if not isinstance(obj, dict):
-        raise InvalidRequest(f"spec must be an object, got "
-                             f"{type(obj).__name__}", site="serve.parse")
-
-    def dec_item(d, where: str):
-        if not isinstance(d, dict):
-            raise InvalidRequest(f"{where}: body item must be an object",
-                                 site="serve.parse")
-        if "array" in d:    # a Ref
-            name = d.get("name")
-            arr = d.get("array")
-            terms = d.get("addr_terms")
-            if not isinstance(name, str) or not isinstance(arr, str):
-                raise InvalidRequest(f"{where}: ref needs string 'name' "
-                                     "and 'array'", site="serve.parse")
-            if not isinstance(terms, list) or not all(
-                    isinstance(t, list) and len(t) == 2
-                    and all(isinstance(x, int) and not isinstance(x, bool)
-                            for x in t) for t in terms):
-                raise InvalidRequest(
-                    f"{where}: ref {name!r} needs addr_terms as a list of "
-                    "[depth, coef] integer pairs", site="serve.parse")
-            span = d.get("share_span")
-            dtb = d.get("dtype_bytes")
-            for fld, v in (("share_span", span), ("dtype_bytes", dtb)):
-                if v is not None and (isinstance(v, bool)
-                                      or not isinstance(v, int)):
-                    raise InvalidRequest(f"{where}: ref {name!r} field "
-                                         f"{fld!r} must be an integer or "
-                                         "null", site="serve.parse")
-            return Ref(name=name, array=arr,
-                       addr_terms=tuple((t[0], t[1]) for t in terms),
-                       addr_base=_as_int(d, "addr_base", 0, where),
-                       share_span=span,
-                       is_write=bool(d.get("is_write", False)),
-                       dtype_bytes=dtb)
-        if "body" in d:     # a Loop
-            body = d.get("body")
-            if not isinstance(body, list) or not body:
-                raise InvalidRequest(f"{where}: loop needs a non-empty "
-                                     "'body' list", site="serve.parse")
-            bc = d.get("bound_coef")
-            if bc is not None and not (
-                    isinstance(bc, list) and len(bc) == 2
-                    and all(isinstance(x, int) and not isinstance(x, bool)
-                            for x in bc)):
-                raise InvalidRequest(f"{where}: bound_coef must be an "
-                                     "[a, b] integer pair or null",
-                                     site="serve.parse")
-            return Loop(trip=_as_int(d, "trip", None, where),
-                        body=tuple(dec_item(b, where + ".body")
-                                   for b in body),
-                        start=_as_int(d, "start", 0, where),
-                        step=_as_int(d, "step", 1, where),
-                        bound_coef=tuple(bc) if bc is not None else None,
-                        start_coef=_as_int(d, "start_coef", 0, where),
-                        bound_level=_as_int(d, "bound_level", 0, where))
-        raise InvalidRequest(f"{where}: item is neither a ref (has "
-                             "'array') nor a loop (has 'body')",
-                             site="serve.parse")
-
-    name = obj.get("name")
-    if not isinstance(name, str) or not name:
-        raise InvalidRequest("spec needs a non-empty string 'name'",
-                             site="serve.parse")
-    arrays = obj.get("arrays")
-    if not isinstance(arrays, list) or not all(
-            isinstance(a, list) and len(a) == 2 and isinstance(a[0], str)
-            and isinstance(a[1], int) and not isinstance(a[1], bool)
-            and a[1] > 0 for a in arrays):
-        raise InvalidRequest("spec 'arrays' must be a list of "
-                             "[name, elements>0] pairs", site="serve.parse")
-    nests = obj.get("nests")
-    if not isinstance(nests, list) or not nests:
-        raise InvalidRequest("spec needs a non-empty 'nests' list",
-                             site="serve.parse")
-    return LoopNestSpec(
-        name=name,
-        arrays=tuple((a, n) for a, n in arrays),
-        nests=tuple(dec_item(n, f"nests[{i}]")
-                    for i, n in enumerate(nests)),
-    )
-
-
-# ---------------------------------------------------------------------------
 # requests
 
 
@@ -208,6 +79,11 @@ class Request:
     id: str
     kind: str                     # "spec" | "trace" | "sleep"
     cfg: SamplerConfig
+    #: which selector admitted it: "spec" | "trace" | "sleep" | "source"
+    #: ("source" requests become kind "spec" once the frontend derives
+    #: their LoopNestSpec — batching and execution are selector-blind —
+    #: but the SLO counters keep the ingestion surface visible)
+    origin: str = ""
     spec: LoopNestSpec | None = None
     trace: str | None = None
     fmt: str = "u64"
@@ -281,6 +157,55 @@ def _analyze_verdict(spec: LoopNestSpec, cfg: SamplerConfig) -> tuple:
     )
 
 
+@functools.lru_cache(maxsize=64)
+def _derive_source_spec(src: str, name: str) -> LoopNestSpec:
+    """Memoized frontend derivation for serve ``source`` requests (the
+    parse + lower + share-span race analysis dominates admission cost;
+    specs are frozen, so sharing the object across requests is safe).
+    Rejections raise and are deliberately NOT cached — errors stay
+    cheap to recompute and never poison the memo."""
+    from pluss.frontend import from_c
+
+    return from_c(src, name=name)
+
+
+def _spec_from_source(rid: str, obj) -> LoopNestSpec:
+    """Derive a spec from an inline ``source`` request via the frontend's
+    pragma-C parser.  Every frontend rejection — tokenizer, grammar,
+    lowering — is a typed :class:`InvalidRequest` with the PL6xx
+    diagnostics attached as data, exactly like an analyzer rejection."""
+    src = obj.get("source")
+    if not isinstance(src, str) or not src.strip():
+        raise InvalidRequest(
+            f"request {rid!r}: source must be a non-empty string",
+            site="serve.parse")
+    lang = obj.get("lang", "c")
+    if lang != "c":
+        # the Python DSL EXECUTES caller code; it is a CLI surface
+        # (`pluss import file.py`), never a wire one
+        raise InvalidRequest(
+            f"request {rid!r}: lang must be 'c' (the pragma-C subset); "
+            f"got {lang!r} — the Python DSL is not served",
+            site="serve.parse")
+    from pluss.frontend import FrontendError
+
+    name = obj.get("name")
+    if name is not None and not isinstance(name, str):
+        raise InvalidRequest(f"request {rid!r}: name must be a string",
+                             site="serve.parse")
+    try:
+        # memoized like _lint_verdict: a hot source (the daemon's
+        # amortization story) parses + lowers + derives spans ONCE, not
+        # per request.  The derived name is part of the key — and kept
+        # request-stable (no per-request anon ids) so the memo can hit.
+        return _derive_source_spec(src, name or "source")
+    except FrontendError as e:
+        raise InvalidRequest(
+            f"request {rid!r}: source rejected by the frontend: {e}",
+            site="serve.frontend", cause=e,
+            diagnostics=tuple(d.to_dict() for d in e.diagnostics))
+
+
 def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
     """Parse + ADMIT one request object; raises :class:`InvalidRequest`
     on any malformation, unknown model, analyzer rejection, or size
@@ -295,14 +220,15 @@ def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
         rid = f"anon-{next(_anon_ids)}"
     rid = str(rid)
 
-    selectors = [k for k in ("model", "spec", "trace") if obj.get(k)
-                 is not None]
+    selectors = [k for k in ("model", "spec", "trace", "source")
+                 if obj.get(k) is not None]
     if "sleep_ms" in obj and not selectors:
         selectors = ["sleep"]
     if len(selectors) != 1:
         raise InvalidRequest(
-            f"request {rid!r} must name exactly one of model/spec/trace "
-            f"(got {selectors or 'none'})", site="serve.parse")
+            f"request {rid!r} must name exactly one of "
+            f"model/spec/trace/source (got {selectors or 'none'})",
+            site="serve.parse")
 
     def opt_int(key: str, default, minimum: int = 1):
         v = obj.get(key)
@@ -335,6 +261,8 @@ def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
         id=rid,
         kind="sleep" if selectors == ["sleep"] else
              ("trace" if selectors == ["trace"] else "spec"),
+        origin=selectors[0] if selectors[0] in ("trace", "sleep", "source")
+               else "spec",
         cfg=cfg,
         share_cap=opt_int("share_cap", SHARE_CAP),
         window=opt_int("window", None),
@@ -369,8 +297,11 @@ def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
                 site="serve.parse")
         req.trace, req.fmt = path, fmt
         return req
-    # spec request: registry model or inline spec, then the analyzer gate
-    if obj.get("model") is not None:
+    # spec request: registry model, inline spec, or frontend-derived
+    # source, then the analyzer gate
+    if req.origin == "source":
+        spec = _spec_from_source(rid, obj)
+    elif obj.get("model") is not None:
         from pluss.models import REGISTRY
 
         model = obj["model"]

@@ -274,7 +274,10 @@ class Server:
             self._respond_err(reply, rid if rid is None else str(rid),
                               classify(e, site="serve.parse"))
             return
-        obs.counter_add(f"serve.requests.{req.kind}")
+        # counted by ORIGIN (spec/trace/sleep/source): a source-derived
+        # request executes as kind "spec", but the SLO breakdown should
+        # show the ingestion surface it arrived through
+        obs.counter_add(f"serve.requests.{req.origin or req.kind}")
         req.reply = reply
         try:
             self.queue.submit(req)

@@ -8,7 +8,11 @@ mutated in place.  That design needs new generated code per workload and walks o
 access at a time.
 
 Here a workload is a small declarative tree of :class:`Loop` and :class:`Ref`
-nodes.  Because every loop is rectangular (constant trip count), the *position in
+nodes.  Specs need not be hand-written: :mod:`pluss.frontend` derives them
+from a Python loop-nest DSL or from ``#pragma pluss parallel`` C source
+(the shape this IR was modeled on), analyzer-verified; :mod:`pluss.models`
+holds the hand-written corpus, and :mod:`pluss.spec_codec` is the one
+JSON encoding shared by serving, the frontend, and the CLI.  Because every loop is rectangular (constant trip count), the *position in
 the access stream* and the *element address* of every occurrence of every static
 reference are affine functions of the iteration vector.  The XLA engine
 (:mod:`pluss.engine`) exploits that to enumerate whole reference streams with

@@ -1,0 +1,191 @@
+"""The one LoopNestSpec <-> JSON codec, shared by serve, frontend, CLI.
+
+Promoted out of ``pluss/serve/protocol.py`` (which re-exports both
+functions for compatibility): the serving wire protocol, the frontend's
+``--json`` output, `pluss spec dump/load`, and the file-registry loader
+(``pluss.models.register_spec_dir``) must all agree on ONE encoding, and
+a spec round-tripped through any of them must compare equal through this
+module — ``spec_to_json(spec_from_json(doc)) == doc`` for canonical
+documents.
+
+Malformations raise :class:`~pluss.resilience.errors.InvalidRequest`
+(never a KeyError/TypeError leaking schema internals): the codec predates
+this module as serving admission code, and every consumer — the daemon
+included — wants the typed, taxonomy-classified failure.
+"""
+
+from __future__ import annotations
+
+import json
+
+from pluss.resilience.errors import InvalidRequest
+from pluss.spec import Loop, LoopNestSpec, Ref
+
+
+def spec_to_json(spec: LoopNestSpec) -> dict:
+    """JSON-able dict encoding of a spec (inverse of :func:`spec_from_json`)."""
+
+    def enc_item(item):
+        if isinstance(item, Ref):
+            d = {"name": item.name, "array": item.array,
+                 "addr_terms": [list(t) for t in item.addr_terms]}
+            if item.addr_base:
+                d["addr_base"] = item.addr_base
+            if item.share_span is not None:
+                d["share_span"] = item.share_span
+            if item.is_write:
+                d["is_write"] = True
+            if item.dtype_bytes is not None:
+                d["dtype_bytes"] = item.dtype_bytes
+            return d
+        d = {"trip": item.trip, "body": [enc_item(b) for b in item.body]}
+        if item.start:
+            d["start"] = item.start
+        if item.step != 1:
+            d["step"] = item.step
+        if item.bound_coef is not None:
+            d["bound_coef"] = list(item.bound_coef)
+        if item.start_coef:
+            d["start_coef"] = item.start_coef
+        if item.bound_level:
+            d["bound_level"] = item.bound_level
+        return d
+
+    return {"name": spec.name,
+            "arrays": [[a, n] for a, n in spec.arrays],
+            "nests": [enc_item(n) for n in spec.nests]}
+
+
+def _as_int(obj, key: str, default=None, where: str = "spec"):
+    v = obj.get(key, default)
+    if v is None:
+        if default is None:
+            raise InvalidRequest(f"{where}: missing required field "
+                                 f"{key!r}", site="spec.codec")
+        v = default   # explicit null means "use the default"
+    if isinstance(v, bool) or not isinstance(v, int):
+        raise InvalidRequest(f"{where}: field {key!r} must be an integer, "
+                             f"got {v!r}", site="spec.codec")
+    return v
+
+
+def spec_from_json(obj) -> LoopNestSpec:
+    """Decode a spec document; every malformation raises
+    :class:`InvalidRequest` (never a KeyError/TypeError leaking schema
+    internals to the caller)."""
+    if not isinstance(obj, dict):
+        raise InvalidRequest(f"spec must be an object, got "
+                             f"{type(obj).__name__}", site="spec.codec")
+
+    def dec_item(d, where: str):
+        if not isinstance(d, dict):
+            raise InvalidRequest(f"{where}: body item must be an object",
+                                 site="spec.codec")
+        if "array" in d:    # a Ref
+            name = d.get("name")
+            arr = d.get("array")
+            terms = d.get("addr_terms")
+            if not isinstance(name, str) or not isinstance(arr, str):
+                raise InvalidRequest(f"{where}: ref needs string 'name' "
+                                     "and 'array'", site="spec.codec")
+            if not isinstance(terms, list) or not all(
+                    isinstance(t, list) and len(t) == 2
+                    and all(isinstance(x, int) and not isinstance(x, bool)
+                            for x in t) for t in terms):
+                raise InvalidRequest(
+                    f"{where}: ref {name!r} needs addr_terms as a list of "
+                    "[depth, coef] integer pairs", site="spec.codec")
+            span = d.get("share_span")
+            dtb = d.get("dtype_bytes")
+            for fld, v in (("share_span", span), ("dtype_bytes", dtb)):
+                if v is not None and (isinstance(v, bool)
+                                      or not isinstance(v, int)):
+                    raise InvalidRequest(f"{where}: ref {name!r} field "
+                                         f"{fld!r} must be an integer or "
+                                         "null", site="spec.codec")
+            return Ref(name=name, array=arr,
+                       addr_terms=tuple((t[0], t[1]) for t in terms),
+                       addr_base=_as_int(d, "addr_base", 0, where),
+                       share_span=span,
+                       is_write=bool(d.get("is_write", False)),
+                       dtype_bytes=dtb)
+        if "body" in d:     # a Loop
+            body = d.get("body")
+            if not isinstance(body, list) or not body:
+                raise InvalidRequest(f"{where}: loop needs a non-empty "
+                                     "'body' list", site="spec.codec")
+            bc = d.get("bound_coef")
+            if bc is not None and not (
+                    isinstance(bc, list) and len(bc) == 2
+                    and all(isinstance(x, int) and not isinstance(x, bool)
+                            for x in bc)):
+                raise InvalidRequest(f"{where}: bound_coef must be an "
+                                     "[a, b] integer pair or null",
+                                     site="spec.codec")
+            return Loop(trip=_as_int(d, "trip", None, where),
+                        body=tuple(dec_item(b, where + ".body")
+                                   for b in body),
+                        start=_as_int(d, "start", 0, where),
+                        step=_as_int(d, "step", 1, where),
+                        bound_coef=tuple(bc) if bc is not None else None,
+                        start_coef=_as_int(d, "start_coef", 0, where),
+                        bound_level=_as_int(d, "bound_level", 0, where))
+        raise InvalidRequest(f"{where}: item is neither a ref (has "
+                             "'array') nor a loop (has 'body')",
+                             site="spec.codec")
+
+    name = obj.get("name")
+    if not isinstance(name, str) or not name:
+        raise InvalidRequest("spec needs a non-empty string 'name'",
+                             site="spec.codec")
+    arrays = obj.get("arrays")
+    if not isinstance(arrays, list) or not all(
+            isinstance(a, list) and len(a) == 2 and isinstance(a[0], str)
+            and isinstance(a[1], int) and not isinstance(a[1], bool)
+            and a[1] > 0 for a in arrays):
+        raise InvalidRequest("spec 'arrays' must be a list of "
+                             "[name, elements>0] pairs", site="spec.codec")
+    nests = obj.get("nests")
+    if not isinstance(nests, list) or not nests:
+        raise InvalidRequest("spec needs a non-empty 'nests' list",
+                             site="spec.codec")
+    return LoopNestSpec(
+        name=name,
+        arrays=tuple((a, n) for a, n in arrays),
+        nests=tuple(dec_item(n, f"nests[{i}]")
+                    for i, n in enumerate(nests)),
+    )
+
+
+def dump_spec(spec: LoopNestSpec, indent: int | None = 1) -> str:
+    """Spec as canonical JSON text (``pluss spec dump``)."""
+    return json.dumps(spec_to_json(spec), indent=indent)
+
+
+def load_spec_text(text: str, where: str = "spec") -> LoopNestSpec:
+    """Decode JSON text; a parse failure is the same typed
+    :class:`InvalidRequest` as a schema failure."""
+    try:
+        obj = json.loads(text)
+    except ValueError as e:
+        raise InvalidRequest(f"{where}: unparseable spec JSON: {e}",
+                             site="spec.codec", cause=e)
+    return spec_from_json(obj)
+
+
+def load_spec_file(path: str) -> LoopNestSpec:
+    """Decode one ``pluss spec dump``-style file (``pluss spec load``)."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise InvalidRequest(f"cannot read spec file {path}: {e}",
+                             site="spec.codec", cause=e)
+    return load_spec_text(text, where=path)
+
+
+def specs_equal(a: LoopNestSpec, b: LoopNestSpec) -> bool:
+    """Codec equality: two specs whose canonical JSON documents match.
+    (Frozen-dataclass ``==`` is the same relation; going through the
+    codec additionally pins that no field escapes the encoding.)"""
+    return spec_to_json(a) == spec_to_json(b)

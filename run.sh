@@ -21,6 +21,16 @@ python -m pluss.cli lint --all 1>&2
 # still pure host analysis, ~20 s for the registry at default sizes.
 python -m pluss.cli analyze --all 1>&2
 
+# frontend import smoke (tier-1): the checked-in gemm.ppcg_omp-shaped C
+# source → tokenizer → recursive-descent parse → lower → share-span
+# derivation → PR-1 analyzer gate → engine run, with --check-model
+# asserting the histogram + MRC byte-identical to the registry gemm
+# model (the bit-identity gate for machine-derived specs, ~seconds on
+# CPU).  The acc-style block goes to stderr: output.txt keeps only the
+# diffable reference blocks.
+JAX_PLATFORMS=cpu python -m pluss.cli import \
+  pluss/frontend/examples/gemm.ppcg_omp.c --run --check-model gemm --cpu 1>&2
+
 # trace replay smoke (tier-1): compressed-wire (d24v) pack → parallel-feed
 # replay → fault-interrupted checkpoint --resume equivalence + legacy-
 # kernel/serial-feed/plain-pack A/B on a ~1e6-ref synthetic trace, pinned

@@ -1,0 +1,414 @@
+"""Adversarial frontend diagnostics: every out-of-grammar construct —
+in the DSL and in the pragma-C subset — raises a TYPED ``PL6xx``
+``FrontendError`` (never a bare SyntaxError/ValueError), and the serve
+``"source"`` request kind replies ``InvalidRequest`` with the findings
+attached."""
+
+import pytest
+
+import tests.conftest  # noqa: F401
+from pluss import frontend
+from pluss.analysis.diagnostics import CODES
+from pluss.frontend.ir import FrontendError, FrontendRejected
+from pluss.resilience.errors import InvalidRequest
+from pluss.serve.protocol import parse_request
+
+
+def c_raises(src: str) -> FrontendError:
+    with pytest.raises(FrontendError) as ei:
+        frontend.from_c(src, name="adv")
+    return ei.value
+
+
+def check(e: FrontendError, code: str) -> None:
+    # typed: a stable code, findings attached, registered in CODES —
+    # and emphatically not a bare SyntaxError
+    assert e.code == code, (e.code, str(e))
+    assert e.diagnostics and e.diagnostics[0].code == code
+    assert code in CODES
+    assert not isinstance(e, SyntaxError)
+
+
+HEAD = "#define N 8\ndouble A[N][N];\ndouble B[N];\n"
+
+
+# ---------------------------------------------------------------------------
+# pragma-C adversarials
+
+
+def test_c_non_affine_subscript_product():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) for (j = 0; j < N; j++) "
+                 "A[i][i * j] = 1.0;")
+    check(e, "PL601")
+
+
+def test_c_indirect_subscript():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) B[B[i]] = 1.0;")
+    check(e, "PL601")
+
+
+def test_c_division_in_bound():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N / 2; i++) B[i] = 1.0;")
+    check(e, "PL601")
+
+
+def test_c_non_unit_step():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i += 2) B[i] = 1.0;")
+    check(e, "PL602")
+
+
+def test_c_negative_step():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = N - 1; i >= 0; i--) B[i] = 1.0;")
+    check(e, "PL602")
+
+
+def test_c_missing_pragma():
+    e = c_raises(HEAD + "for (i = 0; i < N; i++) B[i] = 1.0;")
+    check(e, "PL603")
+
+
+def test_c_pragma_on_inner_loop():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) {\n"
+                 "#pragma pluss parallel\n"
+                 "for (j = 0; j < N; j++) B[j] = 1.0; }")
+    check(e, "PL603")
+
+
+def test_c_shadowed_loop_var():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) for (i = 0; i < N; i++) "
+                 "B[i] = 1.0;")
+    check(e, "PL604")
+
+
+def test_c_loop_var_shadowing_define():
+    # _affine_factor resolves defines before loop vars: an unshadowed-
+    # looking `for (N = ...)` would silently freeze every subscript at
+    # the define's constant — must be PL604, not a wrong clean spec
+    e = c_raises("#define N 4\ndouble A[8];\ndouble B[8];\n"
+                 "#pragma pluss parallel\n"
+                 "for (N = 0; N < 8; N++) A[N] = B[N];")
+    check(e, "PL604")
+
+
+def test_c_bare_array_lvalue():
+    # `A = B[i];` with A an array: the store must not silently vanish
+    # under the scalar-register convention
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) B = B[i];")
+    check(e, "PL606")
+
+
+def test_dsl_dtype_bytes_validated():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            A = frontend.array("A", 8)
+            with frontend.loop("i", 0, 8, parallel=True) as i:
+                frontend.read(A, i, dtype_bytes="8")
+    check(ei.value, "PL608")
+
+
+def test_c_array_name_colliding_with_define():
+    # defines win in expression resolution: an array named like a
+    # #define would have its loads silently constant-folded away
+    e = c_raises("#define B 4\ndouble A[8];\ndouble B[8];\n"
+                 "#pragma pluss parallel\n"
+                 "for (i = 0; i < 8; i++) A[i] = B[i];")
+    check(e, "PL604")
+
+
+def test_py_user_exception_is_typed():
+    # a plain Python bug in a DSL file surfaces as PL605 with the cause
+    # chained, not as a raw NameError through `pluss import`
+    with pytest.raises(FrontendError) as ei:
+        frontend.from_py("from pluss import frontend\n"
+                         "frontend.array('A', undefined_n)\n")
+    check(ei.value, "PL605")
+    assert isinstance(ei.value.__cause__, NameError)
+
+
+def test_import_polybench_empty_families_is_empty():
+    from pluss.frontend import polybench
+
+    assert polybench.import_polybench(families=[]) == {}
+
+
+def test_dsl_loop_object_reentry_rejected():
+    # reusing one loop object would alias its body into two tree
+    # positions (both nests sharing the union of refs) — typed, never
+    # a silently corrupted recording
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            A = frontend.array("A", 8)
+            lp = frontend.loop("i", 0, 8, parallel=True)
+            with lp as i:
+                frontend.read(A, i)
+            with lp as i:
+                frontend.write(A, i)
+    check(ei.value, "PL608")
+
+
+def test_py_decorated_builder_called_twice_collapses():
+    # a decorated builder called twice records two IDENTICAL kernels:
+    # exact duplicates collapse; different specs under one name error
+    src = (
+        "from pluss import frontend\n"
+        "@frontend.kernel('twice')\n"
+        "def build():\n"
+        "    A = frontend.array('A', 8)\n"
+        "    with frontend.loop('i', 0, 8, parallel=True) as i:\n"
+        "        frontend.read(A, i)\n"
+        "build()\nbuild()\n")
+    specs = frontend.from_py(src)
+    assert [s.name for s in specs] == ["twice"]
+    with pytest.raises(FrontendError) as ei:
+        frontend.from_py(
+            "from pluss import frontend\n"
+            "for n in (4, 8):\n"
+            "    with frontend.kernel('clash'):\n"
+            "        A = frontend.array('A', n)\n"
+            "        with frontend.loop('i', 0, n, parallel=True) as i:\n"
+            "            frontend.read(A, i)\n")
+    check(ei.value, "PL608")
+
+
+def test_c_integer_suffix_literals():
+    # 8L / 3u are integers, not "float literals" (real PolyBench
+    # headers use suffixed defines)
+    src = ("#define N 8L\ndouble A[N];\n#pragma pluss parallel\n"
+           "for (i = 0; i < N; i++) A[i] = A[3u] + 1.0;\n")
+    spec = frontend.from_c(src)
+    assert spec.nests[0].trip == 8
+    assert spec.arrays == (("A", 8),)
+
+
+def test_c_malformed_source():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) { B[i] = 1.0;")
+    check(e, "PL605")
+
+
+def test_c_garbage_is_not_a_syntaxerror():
+    e = c_raises("what even is this @@@")
+    assert e.code in ("PL605", "PL601")
+    assert isinstance(e, FrontendError)
+
+
+def test_c_undeclared_array():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) Z[i] = 1.0;")
+    check(e, "PL606")
+
+
+def test_c_subscript_arity():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) A[i] = 1.0;")
+    check(e, "PL606")
+
+
+def test_c_bound_over_two_vars():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) for (j = 0; j < N; j++) "
+                 "for (k = 0; k < i + j; k++) B[k] = 1.0;")
+    check(e, "PL607")
+
+
+def test_c_float_subscript():
+    e = c_raises(HEAD + "#pragma pluss parallel\n"
+                 "for (i = 0; i < N; i++) B[i * 0.5] = 1.0;")
+    check(e, "PL601")
+
+
+# ---------------------------------------------------------------------------
+# DSL adversarials
+
+
+def test_dsl_non_affine_product():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            A = frontend.array("A", 64)
+            with frontend.loop("i", 0, 8, parallel=True) as i:
+                with frontend.loop("j", 0, 8) as j:
+                    frontend.read(A, i * j)
+    check(ei.value, "PL601")
+
+
+def test_dsl_division_rejected():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            A = frontend.array("A", 8)
+            with frontend.loop("i", 0, 8, parallel=True) as i:
+                frontend.read(A, i // 2)
+    check(ei.value, "PL601")
+
+
+def test_dsl_zero_step():
+    with pytest.raises(FrontendError) as ei:
+        frontend.loop("i", 0, 8, step=0)
+    check(ei.value, "PL602")
+
+
+def test_dsl_top_level_loop_needs_parallel():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            frontend.array("A", 8)
+            with frontend.loop("i", 0, 8):
+                pass
+    check(ei.value, "PL603")
+
+
+def test_dsl_nested_parallel_rejected():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            frontend.array("A", 8)
+            with frontend.loop("i", 0, 8, parallel=True):
+                with frontend.loop("j", 0, 8, parallel=True):
+                    pass
+    check(ei.value, "PL603")
+
+
+def test_dsl_shadowed_var():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            frontend.array("A", 8)
+            with frontend.loop("i", 0, 8, parallel=True):
+                with frontend.loop("i", 0, 8):
+                    pass
+    check(ei.value, "PL604")
+
+
+def test_dsl_ref_outside_loop():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            A = frontend.array("A", 8)
+            frontend.read(A, 0)
+    check(ei.value, "PL608")
+
+
+def test_dsl_out_of_scope_index():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            A = frontend.array("A", 8)
+            with frontend.loop("i", 0, 8, parallel=True) as i:
+                pass
+            with frontend.loop("j", 0, 8, parallel=True):
+                frontend.read(A, i)   # i's loop already closed
+    check(ei.value, "PL608")
+
+
+def test_dsl_out_of_scope_zero_coefficient():
+    # a ZERO-coefficient leak (`0 * i`) must fail typed at recording,
+    # not as a KeyError in the lowering — zero terms are recorded (the
+    # round-trip keeps them), so scope covers every term
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv") as k:
+            A = frontend.array("A", 8)
+            with frontend.loop("i", 0, 8, parallel=True) as i:
+                pass
+            with frontend.loop("j", 0, 8, parallel=True) as j:
+                frontend.read(A, j + 0 * i)
+        k.spec()
+    check(ei.value, "PL608")
+
+
+def test_dsl_duplicate_array():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv"):
+            frontend.array("A", 8)
+            frontend.array("A", 8)
+    check(ei.value, "PL608")
+
+
+def test_dsl_bad_bound_two_vars():
+    with pytest.raises(FrontendError) as ei:
+        with frontend.kernel("adv") as k:
+            A = frontend.array("A", 64)
+            with frontend.loop("i", 0, 8, parallel=True) as i:
+                with frontend.loop("j", 0, 8) as j:
+                    with frontend.loop("k", 0, i + j):
+                        frontend.read(A, 0)
+        k.spec()
+    check(ei.value, "PL607")
+
+
+def test_dsl_no_context():
+    with pytest.raises(FrontendError) as ei:
+        frontend.array("A", 8)
+    check(ei.value, "PL608")
+
+
+def test_analyzer_rejection_is_typed_with_findings(tmp_path):
+    # grammatical source whose spec is WRONG (out-of-bounds read):
+    # FrontendRejected carrying the analyzer's own PL101 finding
+    src = tmp_path / "oob.c"
+    src.write_text(
+        "#define N 8\ndouble A[N];\n"
+        "#pragma pluss parallel\n"
+        "for (i = 0; i < N; i++) A[i + 4] = 1.0;\n")
+    with pytest.raises(FrontendRejected) as ei:
+        frontend.import_path(str(src))
+    e = ei.value
+    assert e.code == "PL609"
+    assert any(d.code == "PL101" for d in e.diagnostics)
+
+
+def test_every_pl6xx_code_is_registered():
+    family = {c for c in CODES if c.startswith("PL6")}
+    assert family == {"PL601", "PL602", "PL603", "PL604", "PL605",
+                      "PL606", "PL607", "PL608", "PL609"}
+    assert all(CODES[c][0] == "frontend" for c in family)
+
+
+# ---------------------------------------------------------------------------
+# serve admission for the "source" kind
+
+
+GOOD_C = ("#define N 8\ndouble A[N];\n#pragma pluss parallel\n"
+          "for (i = 0; i < N; i++) A[i] = A[i] + 1.0;\n")
+
+
+def test_serve_source_admitted_as_spec():
+    req = parse_request({"id": "s", "source": GOOD_C, "name": "srcspec"})
+    assert req.kind == "spec" and req.origin == "source"
+    assert req.spec is not None and req.spec.name == "srcspec"
+    assert req.batch_key()[0] == "spec"   # coalesces like any spec
+
+
+def test_serve_source_rejects_with_findings():
+    bad = GOOD_C.replace("A[i]", "A[i * i]", 1)
+    with pytest.raises(InvalidRequest) as ei:
+        parse_request({"id": "s", "source": bad})
+    diags = ei.value.diagnostics
+    assert diags and diags[0]["code"] == "PL601"
+
+
+def test_serve_source_analyzer_rejection_attaches_findings():
+    oob = GOOD_C.replace("for (i = 0; i < N; i++)",
+                         "for (i = 0; i < N + 4; i++)")
+    with pytest.raises(InvalidRequest) as ei:
+        parse_request({"id": "s", "source": oob})
+    codes = {d["code"] for d in ei.value.diagnostics}
+    assert "PL101" in codes
+
+
+def test_serve_source_py_dialect_refused():
+    with pytest.raises(InvalidRequest):
+        parse_request({"id": "s", "source": "import os", "lang": "py"})
+
+
+def test_serve_source_must_be_string():
+    with pytest.raises(InvalidRequest):
+        parse_request({"id": "s", "source": 42})
+    with pytest.raises(InvalidRequest):
+        parse_request({"id": "s", "source": "   "})
+
+
+def test_serve_source_exclusive_selector():
+    with pytest.raises(InvalidRequest):
+        parse_request({"id": "s", "source": GOOD_C, "model": "gemm"})

@@ -379,3 +379,91 @@ def test_heartbeat_exporter_stop_is_idempotent(tmp_path):
     stop = multihost.start_heartbeat_exporter(str(hb), 1, interval_s=0.2)
     stop()
     stop()
+
+
+# ---------------------------------------------------------------------------
+# the "source" request kind (PR 8): frontend -> analyzer gate -> shared
+# dispatch, end-to-end through a live daemon
+
+
+def _gemm_c(n: int) -> str:
+    from pluss.frontend import polybench
+
+    src = open(polybench.gemm_source_path()).read()
+    return src.replace("#define N 128", f"#define N {n}")
+
+
+def test_source_request_end_to_end(server_factory):
+    srv = server_factory(max_batch=8, max_delay_ms=5)
+    with Client(srv.socket_path) as c:
+        r = c.request({"source": _gemm_c(16), "lang": "c",
+                       "name": "gemm_src", "threads": 2, "chunk": 2,
+                       "output": "both"})
+    assert r["ok"], r
+    assert r["model"] == "gemm_src"
+    # the frontend-derived spec rides the EXISTING spec path: result
+    # bit-identical to the registry model's solo run
+    solo = solo_spec("gemm", 16)
+    assert r["histogram"] == solo["histogram"]
+    assert r["mrc"] == solo["mrc"]
+
+
+def test_source_request_coalesces_with_model_request(server_factory):
+    # a source-derived gemm and the registry gemm have equal specs ->
+    # equal dispatch keys -> ONE shared dispatch serves both
+    srv = server_factory(max_batch=8, max_delay_ms=200)
+    src = _gemm_c(16)
+    results = {}
+
+    def one(key, req):
+        with Client(srv.socket_path) as c:
+            results[key] = c.request(req)
+
+    # park a slow sleep first so the batcher lingers and both arrive
+    with Client(srv.socket_path) as c:
+        c.request({"sleep_ms": 150})
+    ts = [threading.Thread(target=one, args=("src", {
+              "source": src, "name": "gemm16", "threads": 2,
+              "chunk": 2})),
+          threading.Thread(target=one, args=("model", {
+              "model": "gemm", "n": 16, "threads": 2, "chunk": 2}))]
+    with Client(srv.socket_path) as c:
+        hold = c.send({"sleep_ms": 300})
+        for t in ts:
+            t.start()
+        time.sleep(0.1)
+        for t in ts:
+            t.join()
+        c.recv(hold)
+    assert results["src"]["ok"] and results["model"]["ok"]
+    # both answered identically (the coalesce itself is timing-
+    # dependent; bit-identity of the shared path is the contract)
+    assert results["src"]["mrc"] == results["model"]["mrc"]
+
+
+def test_source_request_rejection_with_findings(server_factory):
+    srv = server_factory()
+    bad = _gemm_c(8).replace("A[c0][c2]", "A[c0][c0 * c2]")
+    with Client(srv.socket_path) as c:
+        r = c.request({"source": bad, "lang": "c"})
+        r2 = c.request({"source": _gemm_c(8), "lang": "py"})
+    assert not r["ok"]
+    assert r["error"]["type"] == "InvalidRequest"
+    assert r["error"]["diagnostics"][0]["code"] == "PL601"
+    assert not r2["ok"] and r2["error"]["type"] == "InvalidRequest"
+
+
+def test_source_requests_counted_by_origin(server_factory, tmp_path):
+    # the SLO counters key on the ingestion surface: a source request
+    # executes as kind "spec" but counts serve.requests.source
+    obs.configure(str(tmp_path / "tel.jsonl"))
+    try:
+        srv = server_factory()
+        with Client(srv.socket_path) as c:
+            assert c.request({"source": _gemm_c(8), "threads": 2,
+                              "chunk": 2})["ok"]
+            stats = c.request({"op": "stats"})
+    finally:
+        obs.shutdown()
+    assert stats["counters"].get("serve.requests.source") == 1
+    assert "serve.requests.spec" not in stats["counters"]
