@@ -567,6 +567,52 @@ def bench_trace(n_refs: int) -> None:
          shrunk=bool(n_run != n_refs), **obs_extra)
 
 
+def bench_multichip(trace_refs: int) -> None:
+    """Multi-chip scale-out headlines (round r09 on): refs/s of the
+    work-stealing sharded dispatch vs the single-device engine on the
+    quad nests (cholesky/lu — the straggler-bound surface) and the
+    streamed headline trace, with ``scaling_efficiency`` and steal stats
+    on every line.  Measured in-process when this process already sees a
+    multi-device backend; otherwise re-measured in a subprocess on an
+    8-fake-device CPU mesh (XLA parses the host-device-count flag once
+    per process), clearly labeled ``cpu_fake8`` — either way the record
+    carries a MEASUREMENT, not a dry-run ok-bit."""
+    import jax
+
+    from pluss import multichip_smoke
+
+    if len(jax.devices()) >= 2:
+        multichip_smoke.bench_lines(min(trace_refs, 1 << 27),
+                                    label_refs=trace_refs)
+        return
+    # single visible device (the tunneled TPU): subprocess on a virtual
+    # CPU mesh.  The child gets its OWN telemetry sink — inheriting the
+    # parent's would truncate the live stream (Telemetry opens 'w').
+    budget = max(60, min(int(remaining_s() - 30), 420))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PLUSS_TELEMETRY": ".bench/multichip_telemetry.jsonl"}
+    env.pop("PLUSS_XPROF", None)
+    env.pop("PLUSS_PROM", None)
+    cmd = [sys.executable, "-m", "pluss.multichip_smoke", "--bench",
+           "--devices", "8", "--trace-refs", str(min(trace_refs, 1 << 22)),
+           "--label-refs", str(trace_refs)]
+    log(f"bench: multichip measured in a subprocess (8 fake CPU devices, "
+        f"budget {budget}s)")
+    try:
+        out = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                             timeout=budget, check=True)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        tail = (getattr(e, "stderr", "") or "")[-400:]
+        log(f"bench: multichip subprocess failed: {e}; stderr tail: {tail}")
+        return
+    for ln in out.stderr.splitlines():
+        if ln.strip():
+            log(ln)
+    for ln in out.stdout.splitlines():   # already bench-schema JSON lines
+        if ln.strip():
+            print(ln, flush=True)
+
+
 def bench_serve(n_requests: int = 48) -> None:
     """Serving headline (round r07 on): p50/p99 request latency and
     throughput of an in-process ``pluss serve`` daemon under a mixed,
@@ -743,6 +789,13 @@ def main() -> int:
             bench_import()
         except Exception as e:
             log(f"bench: import metric failed: {e}")
+        if budget_ok("multichip", 240):
+            try:
+                bench_multichip(
+                    int(os.environ.get("PLUSS_BENCH_TRACE_REFS",
+                                       1_000_000_000)))
+            except Exception as e:
+                log(f"bench: multichip metric failed: {e}")
         return 0
 
     # headline FIRST (round 3's record has rc=124 with this metric still
@@ -836,6 +889,15 @@ def main() -> int:
             bench_trace(trace_refs)
         except Exception as e:
             log(f"bench: trace metric failed: {e}")
+
+    # multi-chip scale-out headlines (round r09 on): work-stealing sharded
+    # dispatch vs single device on the quad nests + the streamed trace,
+    # scaling_efficiency + steal stats stamped on every line
+    if budget_ok("multichip", 300):
+        try:
+            bench_multichip(trace_refs)
+        except Exception as e:
+            log(f"bench: multichip metric failed: {e}")
 
     # serving headline (round r07 on): what a tenant of `pluss serve`
     # experiences — p50/p99 latency and req/s, batched vs unbatched A/B

@@ -53,7 +53,8 @@ BACKENDS = ("vmap", "shard", "seq")
 
 
 def _sampler_of(backend: str, spec, cfg: SamplerConfig, share_cap: int,
-                window: int | None = None, start_point: int | None = None):
+                window: int | None = None, start_point: int | None = None,
+                dispatch: str | None = None):
     """() -> (result, rihist) closure for one backend."""
     if backend == "shard":
         from pluss.parallel.shard import default_mesh, shard_run
@@ -61,7 +62,8 @@ def _sampler_of(backend: str, spec, cfg: SamplerConfig, share_cap: int,
         mesh = default_mesh()
         run_once = lambda: shard_run(spec, cfg, share_cap, mesh,
                                      start_point=start_point,
-                                     window_accesses=window)
+                                     window_accesses=window,
+                                     dispatch=dispatch)
     else:
         run_once = lambda: engine.run(spec, cfg, share_cap,
                                       start_point=start_point,
@@ -402,6 +404,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--backends", default=None,
                    help="comma list of " + ",".join(BACKENDS)
                         + " (default: all three)")
+    p.add_argument("--shard-dispatch", default=None,
+                   choices=("auto", "steal", "static"),
+                   help="shard backend / sharded trace replay: chunk "
+                        "dispatch mode — steal (host-side work-stealing "
+                        "over per-device chunk queues; single-process "
+                        "default), static (one shard_map program; the "
+                        "multi-process mode), or auto (PLUSS_SHARD_DISPATCH "
+                        "env).  Bit-identical either way")
+    p.add_argument("--device-groups", type=int, default=None,
+                   help="sweep mode: split the local devices into this "
+                        "many groups and run one sweep point per group "
+                        "concurrently (journaled elastic recovery requeues "
+                        "a point whose worker dies); default serial")
     p.add_argument("--threads", type=int, default=4, help="simulated threads")
     p.add_argument("--chunk", type=int, default=4, help="schedule chunk size")
     p.add_argument("--reps", type=int, default=3, help="speed-mode repetitions")
@@ -622,7 +637,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.mode == "acc":
         for b in backends:
             step = _sampler_of(b, spec, cfg, args.share_cap,
-                               args.window, args.start_point)
+                               args.window, args.start_point,
+                               args.shard_dispatch)
             step()  # warmup: exclude compilation from the timed region
             dt, res, ri = _timed(step, args.profile)
             acc_block(banner_of(b), dt, res.noshare_list(), res.share_list(),
@@ -630,13 +646,15 @@ def main(argv: list[str] | None = None) -> int:
     elif args.mode == "speed":
         for b in backends:
             step = _sampler_of(b, spec, cfg, args.share_cap,
-                               args.window, args.start_point)
+                               args.window, args.start_point,
+                               args.shard_dispatch)
             step()  # warmup once per backend
             times = [_timed(step)[0] for _ in range(args.reps)]
             speed_block(banner_of(b), times, out)
     elif args.mode == "mrc":
         step = _sampler_of(backends[0], spec, cfg, args.share_cap,
-                           args.window, args.start_point)
+                           args.window, args.start_point,
+                           args.shard_dispatch)
         _, res, ri = _timed(step, args.profile)
         curve = mrc.aet_mrc(ri, cfg)
         mrc.write_mrc(args.out, curve)
@@ -675,8 +693,13 @@ def main(argv: list[str] | None = None) -> int:
         if args.resume:
             print(f"pluss: sweep journal at {journal} (resume on)",
                   file=sys.stderr)
+        if args.device_groups is not None and args.device_groups > 1:
+            print(f"pluss: sweep across {args.device_groups} device "
+                  "group(s), one point per group (elastic requeue on "
+                  "worker death)", file=sys.stderr)
         pts = sweep_mod.sweep(spec, ts, cks, cfg, args.share_cap,
-                              journal=journal, resume=args.resume)
+                              journal=journal, resume=args.resume,
+                              device_groups=args.device_groups)
         out.write(f"{spec.name}: predicted miss ratios\n")
         out.write(sweep_mod.table(pts, cls_) + "\n")
         # one report surface for the static analyzer's carried-level
@@ -760,7 +783,8 @@ def main(argv: list[str] | None = None) -> int:
                           file=sys.stderr)
                 rep = trace_mod.shard_replay_file(
                     args.file, cls=cfg.cls, window=win,
-                    checkpoint_path=ckpt, resume=args.resume, **bw_kw)
+                    checkpoint_path=ckpt, resume=args.resume,
+                    dispatch=args.shard_dispatch, **bw_kw)
             else:
                 if args.resume or args.journal:
                     print("pluss: --resume/--journal have no effect on "

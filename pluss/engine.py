@@ -1495,6 +1495,27 @@ def _plan_cached(spec: LoopNestSpec, cfg: SamplerConfig, assignment,
                     sort_concurrency=sort_concurrency)
 
 
+@functools.lru_cache(maxsize=32)
+def shard_plan_cached(spec: LoopNestSpec, cfg: SamplerConfig, assignment,
+                      start_point, window_accesses,
+                      n_windows: int) -> StreamPlan:
+    """Shared plan memo of the SHARDED backend's two dispatch modes.
+
+    The static ``shard_map`` executable and the work-stealing chunk
+    dispatcher (:mod:`pluss.parallel.shard`) plan the identical
+    ``n_windows`` grid, so they share ONE plan object here — host
+    planning (templates, clock tables) runs once per coordinate, and the
+    chunk executables cached on the plan object survive a dispatch-mode
+    flip.  Overlays and row-private tables are skipped exactly as the
+    shard backend has always skipped them (its windows sort the full
+    ``var_refs``)."""
+    with obs.span("engine.plan", model=spec.name, threads=cfg.thread_num,
+                  chunk=cfg.chunk_size, backend="shard"):
+        return plan(spec, cfg, assignment, start_point, window_accesses,
+                    n_windows=n_windows, build_overlays=False,
+                    build_rowpriv=False)
+
+
 def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                share_cap: int = SHARE_CAP, assignment=None, start_point=None,
                window_accesses=None, thread_batch: int | None = None,
@@ -1654,11 +1675,14 @@ def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
 
 
 def _clear_compiled_caches() -> None:
-    """Clear the executable memo AND the plan memo it feeds from: plan
+    """Clear the executable memo AND the plan memos it feeds from: plan
     content depends on env toggles (PLUSS_NO_OVERLAY, PLUSS_NO_ROWPRIV),
-    so clearing only the outer cache would hand back stale plans."""
+    so clearing only the outer cache would hand back stale plans.  The
+    shard plan memo (whose plans carry chunk executables) clears with
+    them — the sharded backend's own lru rides on these plan objects."""
     _compiled.cache_clear()
     _plan_cached.cache_clear()
+    shard_plan_cached.cache_clear()
 
 
 #: tests and tools clear the executable memo through the public name
@@ -1686,6 +1710,12 @@ class SamplerResult:
     #: clean first-attempt run) — stamped by pluss.resilience.run_resilient,
     #: surfaced by describe_path(..., degradations=...) and bench records
     degradations: tuple = ()
+    #: how the run was executed across devices (the sharded backend stamps
+    #: dispatch mode, device count, chunk/steal schedule stats); None for
+    #: single-device engine runs.  Pure metadata — never part of result
+    #: equality semantics the differential tests assert (they compare the
+    #: histogram/share fields explicitly)
+    dispatch_stats: dict | None = None
 
     @property
     def thread_num(self) -> int:

@@ -311,6 +311,38 @@ def serve_breakdown(counters: dict[str, float],
     return lines
 
 
+def shard_breakdown(counters: dict[str, float],
+                    gauges: dict[str, float]) -> list[str]:
+    """The multi-chip scale-out block: chunk dispatch volume, how much
+    rebalancing the work-stealing dispatcher actually did, and each
+    device's busy fraction (a balanced fleet shows near-equal fractions;
+    a straggler-bound one shows the gap stealing is closing).  Empty when
+    the stream has no shard dispatch activity."""
+    chunks = counters.get("shard.chunks")
+    if not chunks:
+        return []
+    lines = ["shard scale-out:"]
+    lines.append(f"  {'chunks dispatched':<28} {int(chunks):>9}")
+    steals = counters.get("shard.steals", 0.0)
+    lines.append(f"  {'chunks stolen':<28} {int(steals):>9}  "
+                 f"({100.0 * steals / chunks:.1f}%)")
+    busy = sorted(((int(k.rsplit(".", 1)[1]), v)
+                   for k, v in gauges.items()
+                   if k.startswith("shard.device_busy_frac.")))
+    if busy:
+        lines.append("  device busy fractions (last) "
+                     + " ".join(f"d{i}={v:.2f}" for i, v in busy))
+    retries = counters.get("engine.share_cap_retries")
+    if retries:
+        lines.append(f"  {'share-cap retries':<28} {int(retries):>9}")
+    deaths = counters.get("multihost.worker_deaths")
+    if deaths:
+        salv = counters.get("multihost.salvages", 0.0)
+        lines.append(f"  {'worker deaths / salvages':<28} "
+                     f"{int(deaths):>9} / {int(salv)}")
+    return lines
+
+
 def render(records: list[dict], out) -> None:
     """Write the human report for one loaded stream."""
     n_spans = sum(1 for r in records if r.get("ev") == "span")
@@ -351,6 +383,9 @@ def render(records: list[dict], out) -> None:
     sblock = serve_breakdown(counters, gauges)
     if sblock:
         out.write("\n".join(sblock) + "\n")
+    shblock = shard_breakdown(counters, gauges)
+    if shblock:
+        out.write("\n".join(shblock) + "\n")
 
 
 def main(path: str, out, err, check: bool = False) -> int:

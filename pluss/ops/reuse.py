@@ -89,30 +89,42 @@ def sort_stream(line, pos, span, valid, pos_sorted: bool = False):
     return key_s, pos_s, span_s, valid_s
 
 
-def batch_events(line, pos, valid, last_pos, span=None):
+def batch_events(line, pos, valid, last_pos, span=None,
+                 pos_sorted: bool = True):
     """Whole-batch segmented reuse extraction: ONE sort, ONE carried gather,
     ONE tail scatter for an arbitrarily large stream slice.
 
     This is the PARDA/SHARDS-style decomposition (Niu et al.; Waldspurger
     et al.): instead of scanning a batch as ``n/window`` dependent windows
     (a device dependency chain), sort the entire slice by ``(line, pos)``
-    at once — ``pos`` MUST arrive in ascending stream order, so a single
-    *stable* sort on the line key alone realizes the two-key order — and
-    every intra-batch reuse interval is a segment-internal position diff,
-    all computed in one vectorized subtraction.  The persistent
-    ``last_pos`` table is touched once per batch: one (sorted-index)
-    gather resolves segment heads, one permutation scatter writes segment
-    tails; only the first/last occurrence per distinct line takes effect.
+    at once — when ``pos`` arrives in ascending stream order
+    (``pos_sorted=True``, the trace replay feed) a single *stable* sort on
+    the line key alone realizes the two-key order — and every intra-batch
+    reuse interval is a segment-internal position diff, all computed in
+    one vectorized subtraction.  The persistent ``last_pos`` table is
+    touched once per batch: one (sorted-index) gather resolves segment
+    heads, one permutation scatter writes segment tails; only the
+    first/last occurrence per distinct line takes effect.
+
+    ``pos_sorted=False`` admits streams NOT in position order (the affine
+    enumeration of the engine/shard windows, where refs concatenate in
+    program order): the full two-key comparator runs instead of the
+    stable single-key sort — same decomposition, one extra sort key.
+    ``span`` carries the per-access share span (None for trace streams,
+    which have no share classification).
 
     Exposed as a standalone primitive so the trace replay path
-    (:mod:`pluss.trace`) and the engine's ultra-window path can share it.
-    Returns ``(ev, new_last_pos)`` exactly like :func:`window_events` —
-    and is bit-identical to scanning the same slice window-by-window,
-    because reuse intervals are pairwise same-line gaps, invariant under
-    how the stream is partitioned.
+    (:mod:`pluss.trace`) and the sharded engine's window path
+    (:mod:`pluss.parallel.shard`) can share it.  Returns
+    ``(ev, new_last_pos)`` exactly like :func:`window_events` — and is
+    bit-identical to scanning the same slice window-by-window (or to the
+    ghost-merged formulation of :func:`carried_events`), because reuse
+    intervals are pairwise same-line gaps, invariant under how the stream
+    is partitioned and how the carry is resolved.
     """
     return window_events(
-        *sort_stream(line, pos, span, valid, pos_sorted=True), last_pos)
+        *sort_stream(line, pos, span, valid, pos_sorted=pos_sorted),
+        last_pos)
 
 
 def window_events(key_s, pos_s, span_s, valid_i, last_pos):
@@ -137,6 +149,10 @@ def window_events(key_s, pos_s, span_s, valid_i, last_pos):
       cold:   first *global* touch of a line (contributes to the cold key -1)
       head:   first in-window touch of a line
       tail:   last in-window touch of a line
+      key/pos/span: the sorted input arrays themselves, aligned with the
+              event arrays — consumers that post-process events positionally
+              (the sharded backend's device-head capture) read them here
+              instead of re-sorting
 
     and ``new_last_pos`` is the carry advanced past this window (``None`` when
     ``last_pos`` is ``None``).
@@ -182,6 +198,9 @@ def window_events(key_s, pos_s, span_s, valid_i, last_pos):
         "cold": cold,
         "head": head,
         "tail": tail,
+        "key": key_s,
+        "pos": pos_s,
+        "span": span_s,
     }, new_last_pos
 
 

@@ -5,8 +5,13 @@ SURVEY.md §2/§5); this framework's cross-device story is XLA collectives, whic
 makes multi-host support a *configuration* problem rather than a code path:
 :func:`pluss.parallel.shard.shard_run` only uses ``all_gather`` and ``psum``,
 both of which XLA routes over ICI within a slice and DCN across hosts, with no
-point-to-point communication anywhere.  This module provides the standard
-JAX multi-process bring-up around it, **hardened** (PR 2):
+point-to-point communication anywhere.  (The work-stealing chunk dispatch —
+PR 9 — is a SINGLE-process mode: it places chunks on addressable devices, so
+``shard_run``'s ``auto`` dispatch resolves to the static collectives-only
+program whenever ``jax.process_count() > 1``; the two are bit-identical, so
+a fleet mixing single-process steal runs with multi-process static runs
+stays exactly comparable.)  This module provides the standard JAX
+multi-process bring-up around it, **hardened** (PR 2):
 
 - :func:`initialize` retries the coordinator connect under a bounded
   exponential backoff and a per-attempt timeout, classifying terminal
@@ -369,6 +374,14 @@ def watched_shard_run(spec, cfg=None, share_cap: int | None = None,
     The watchdog only arms after every worker has produced a first beat
     (bounded by ``first_beat_timeout_s``), so slow bring-up is not
     mistaken for death.
+
+    ``**kw`` forwards to :func:`shard_run` — including ``dispatch=``:
+    under multi-process execution the ``auto`` default resolves to the
+    static collectives-only program (the only mode a watchdog over DCN
+    collectives is FOR; the single-process work-stealing dispatcher has
+    no hangable collective and needs no watchdog), and the subprocess
+    salvage path is dispatch-agnostic because ``shard_run`` ≡
+    ``engine.run`` bit-for-bit in every mode.
     """
     from pluss.config import DEFAULT, SHARE_CAP
     from pluss.parallel.shard import shard_run

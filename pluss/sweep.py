@@ -65,13 +65,72 @@ def _intkeys(d: dict) -> dict:
     return {int(k): v for k, v in d.items()}
 
 
+def _sweep_point(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
+                 journal, resume: bool, jlock=None, mesh=None):
+    """One sweep point: restore-from-journal or compute, journal durably,
+    and return ``(curve, refs, degradations)``.  ``jlock`` serializes
+    journal access when point workers run concurrently (each record is a
+    single buffered write — unlocked concurrent appends could interleave
+    partial lines).  ``mesh`` (a >1-device group) routes the sampler run
+    through the sharded backend; the backend-equivalence contract
+    (``shard_run`` ≡ ``engine.run``, bit-exact) makes the curve identical
+    either way."""
+    import contextlib
+
+    from pluss import obs
+    from pluss.resilience import run_resilient
+
+    t, cs = cfg.thread_num, cfg.chunk_size
+    key = _point_key(spec, cfg)
+    lock = jlock if jlock is not None else contextlib.nullcontext()
+    # one span per point, restored-from-journal or computed — the
+    # per-point timings `pluss stats` rolls up to show where a
+    # multi-config sweep's wall clock actually went
+    with obs.span("sweep.point", model=spec.name, threads=t, chunk=cs) as sp:
+        rec = None
+        if journal is not None and resume:
+            with lock:
+                rec = journal.get(key)
+        if rec is not None:
+            noshare = [_intkeys(d) for d in rec["noshare"]]
+            share = [{int(r): _intkeys(h) for r, h in d.items()}
+                     for d in rec["share"]]
+            refs = rec["refs"]
+            degradations = ("journal",) + tuple(rec.get(
+                "degradations", ()))
+            obs.counter_add("sweep.points_restored")
+        else:
+            if mesh is not None:
+                # multi-device groups ride the ladder too (backend="shard"
+                # takes SHARD_LADDER), so a degradable fault degrades the
+                # point — stamped — instead of burning its one elastic
+                # requeue on something the ladder would have absorbed
+                res = run_resilient(spec, cfg, share_cap, backend="shard",
+                                    mesh=mesh)
+            else:
+                res = run_resilient(spec, cfg, share_cap)
+            noshare, share = res.noshare_list(), res.share_list()
+            refs = res.max_iteration_count
+            degradations = tuple(res.degradations)
+            if journal is not None:
+                with lock:
+                    journal.record(key, noshare=noshare, share=share,
+                                   refs=refs,
+                                   degradations=list(degradations))
+            obs.counter_add("sweep.points_run")
+        sp.set(refs=refs, restored=rec is not None)
+        ri = cri.distribute(noshare, share, t)
+        return mrc.aet_mrc(ri, cfg), refs, degradations
+
+
 def sweep(spec: LoopNestSpec,
           thread_nums: Sequence[int] = (1, 2, 4, 8),
           chunk_sizes: Sequence[int] = (4,),
           base_cfg: SamplerConfig = SamplerConfig(),
           share_cap: int = SHARE_CAP,
           journal=None,
-          resume: bool = False) -> list[SweepPoint]:
+          resume: bool = False,
+          device_groups: int | None = None) -> list[SweepPoint]:
     """Predict the MRC of ``spec`` under each (thread_num, chunk_size).
 
     ``journal``: a :class:`pluss.resilience.Journal` (or a path string) —
@@ -80,48 +139,115 @@ def sweep(spec: LoopNestSpec,
     instead of recomputed (the sampler run is the expensive part; the
     CRI + AET tail is deterministic host math and replays in
     milliseconds), stamped ``degradations=('journal',) + <original>``.
+
+    ``device_groups``: split the local devices into that many groups and
+    run ONE POINT PER GROUP concurrently (a 1-device group pins
+    ``engine.run`` to its device; a multi-device group runs the sharded
+    backend over its sub-mesh).  Points are ELASTIC: a point whose worker
+    dies with a classified :class:`~pluss.resilience.errors.PlussError`
+    is requeued once onto another group (``sweep.elastic_requeues``), and
+    the journal means a sweep killed mid-flight resumes with ZERO
+    recomputation of finished points.  Results are returned in canonical
+    point order and are bit-identical to the serial sweep (the CRI + AET
+    tail is deterministic host math; ``shard_run`` ≡ ``engine.run``).
     """
-    from pluss import obs
-    from pluss.resilience import run_resilient
     from pluss.resilience.journal import Journal
 
     if isinstance(journal, str):
         journal = Journal(journal)
+    cfgs = [dataclasses.replace(base_cfg, thread_num=t, chunk_size=cs)
+            for t in thread_nums for cs in chunk_sizes]
+    if device_groups is not None and device_groups > 1 and len(cfgs) > 1:
+        return _sweep_parallel(spec, cfgs, share_cap, journal, resume,
+                               device_groups)
     out = []
-    for t in thread_nums:
-        for cs in chunk_sizes:
-            cfg = dataclasses.replace(base_cfg, thread_num=t, chunk_size=cs)
-            key = _point_key(spec, cfg)
-            # one span per point, restored-from-journal or computed — the
-            # per-point timings `pluss stats` rolls up to show where a
-            # multi-config sweep's wall clock actually went
-            with obs.span("sweep.point", model=spec.name, threads=t,
-                          chunk=cs) as sp:
-                rec = journal.get(key) if (journal is not None and resume) \
-                    else None
-                if rec is not None:
-                    noshare = [_intkeys(d) for d in rec["noshare"]]
-                    share = [{int(r): _intkeys(h) for r, h in d.items()}
-                             for d in rec["share"]]
-                    refs = rec["refs"]
-                    degradations = ("journal",) + tuple(rec.get(
-                        "degradations", ()))
-                    obs.counter_add("sweep.points_restored")
-                else:
-                    res = run_resilient(spec, cfg, share_cap)
-                    noshare, share = res.noshare_list(), res.share_list()
-                    refs = res.max_iteration_count
-                    degradations = tuple(res.degradations)
-                    if journal is not None:
-                        journal.record(key, noshare=noshare, share=share,
-                                       refs=refs,
-                                       degradations=list(degradations))
-                    obs.counter_add("sweep.points_run")
-                sp.set(refs=refs, restored=rec is not None)
-                ri = cri.distribute(noshare, share, t)
-                out.append(SweepPoint(cfg, mrc.aet_mrc(ri, cfg), refs,
-                                      degradations))
+    for cfg in cfgs:
+        curve, refs, degradations = _sweep_point(spec, cfg, share_cap,
+                                                 journal, resume)
+        out.append(SweepPoint(cfg, curve, refs, degradations))
     return out
+
+
+def _sweep_parallel(spec: LoopNestSpec, cfgs, share_cap: int, journal,
+                    resume: bool, device_groups: int) -> list[SweepPoint]:
+    """One-point-per-device-group sweep with elastic requeue (see
+    :func:`sweep`)."""
+    import queue
+    import threading
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from pluss import obs
+    from pluss.resilience.errors import PlussError
+
+    devices = jax.devices()
+    G = max(1, min(device_groups, len(devices), len(cfgs)))
+    per = len(devices) // G
+    groups = [devices[g * per:(g + 1) * per] for g in range(G)]
+    jlock = threading.Lock()
+    results: list = [None] * len(cfgs)
+    errors: list = []
+    attempts = [0] * len(cfgs)
+    q: queue.Queue = queue.Queue()
+    for i in range(len(cfgs)):
+        q.put(i)
+
+    def worker(gi: int) -> None:
+        group = groups[gi]
+        mesh = Mesh(np.asarray(group), ("d",)) if len(group) > 1 else None
+        while True:
+            try:
+                i = q.get_nowait()
+            except queue.Empty:
+                return
+            attempts[i] += 1
+            try:
+                if mesh is None:
+                    with jax.default_device(group[0]):
+                        results[i] = _sweep_point(spec, cfgs[i], share_cap,
+                                                  journal, resume, jlock)
+                else:
+                    results[i] = _sweep_point(spec, cfgs[i], share_cap,
+                                              journal, resume, jlock, mesh)
+            except PlussError as e:
+                if attempts[i] <= 1:
+                    # elastic recovery: the point goes back on the queue
+                    # for ANOTHER group's worker (this one exits — its
+                    # device may be the sick one); finished points stay
+                    # finished, journaled or in results[]
+                    obs.counter_add("sweep.elastic_requeues")
+                    obs.event("sweep.point_requeued", model=spec.name,
+                              threads=cfgs[i].thread_num,
+                              chunk=cfgs[i].chunk_size, error=type(e).__name__)
+                    q.put(i)
+                    return
+                errors.append(e)
+                return
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=worker, args=(gi,), daemon=True,
+                                name=f"pluss-sweep-{gi}")
+               for gi in range(G)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    missing = [i for i, r in enumerate(results) if r is None]
+    if missing and not errors:
+        # every worker that could serve a requeued point has exited:
+        # finish the stragglers inline (the coordinator thread is the
+        # elastic backstop)
+        for i in missing:
+            results[i] = _sweep_point(spec, cfgs[i], share_cap, journal,
+                                      resume, jlock)
+        missing = []
+    if errors:
+        raise errors[0]
+    return [SweepPoint(cfg, *res) for cfg, res in zip(cfgs, results)]
 
 
 def table(points: Iterable[SweepPoint], cache_lines: Sequence[int]) -> str:

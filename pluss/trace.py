@@ -1903,6 +1903,117 @@ def shard_replay(addrs: np.ndarray, cls: int = 64, mesh=None,
     return ReplayResult(np.asarray(hist, np.int64), n, n_lines)
 
 
+@functools.lru_cache(maxsize=8)
+def _steal_chunk_fn(backend: str, pos_dtype_name: str):
+    """Per-device chunk executable of the work-stealing sharded replay:
+    ONE :func:`pluss.ops.reuse.batch_events` call covers the whole chunk
+    (the PR-4 segmented kernel — sort, carried gather, tail scatter), with
+    a fresh carry per chunk; first-in-chunk touches are captured as HEADS
+    for the host-side boundary merge.  ``L`` (the line-table capacity at
+    the chunk's compaction time) is static — growth retraces, like
+    :func:`replay_file`'s."""
+    from pluss.parallel.shard import _capture_heads
+
+    pdt = jnp.dtype(pos_dtype_name)
+
+    def f(ids, base, n_valid, L):
+        pos = base + jnp.arange(ids.shape[0], dtype=pdt)
+        ev, tail = batch_events(ids, pos, pos < n_valid,
+                                jnp.full((L,), -1, pdt))
+        hist = event_histogram(ev, include_cold=False)
+        head, _ = _capture_heads(jnp.full((L,), -1, pdt), None, ev["cold"],
+                                 ev["key"], ev["pos"], None, L)
+        return hist, head, tail
+
+    return jax.jit(f, static_argnums=(3,))
+
+
+def _shard_replay_file_steal(path: str, cls: int, mesh, window: int,
+                             precompacted: bool,
+                             batch_windows: int) -> ReplayResult:
+    """Work-stealing sharded replay: a sequential reader+compactor feeds
+    chunk ids into a bounded queue; per-device workers pull the next
+    produced chunk (:class:`pluss.parallel.steal.QueueDispatcher` — idle
+    devices rebalance themselves, counted as steals), and the host merges
+    chunk boundaries with a running prefix-max in stream order.  The merge
+    order is canonical, so the pull schedule never reaches the result —
+    bit-identical to :func:`replay_file` / the static sharded scan."""
+    from pluss import obs as _obs
+    from pluss.parallel.shard import np_head_hist
+    from pluss.parallel.steal import QueueDispatcher
+    from pluss.resilience import faults
+
+    devices = list(mesh.devices.ravel())
+    D = len(devices)
+    n = _u64_count(path)
+    if n == 0:
+        return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)
+    if cls & (cls - 1):
+        raise ValueError(f"cache line size {cls} is not a power of two")
+    shift = int(cls).bit_length() - 1
+    bw = _resolve_bw(batch_windows)
+    chunk = bw * window
+    n_chunks = -(-n // chunk)
+    pos_dtype = "int32" if n < 2**31 - 2 else "int64"
+    if pos_dtype == "int64" and not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            f"trace of {n} accesses needs int64 positions; enable "
+            "jax_enable_x64")
+    npdt = np.dtype(pos_dtype)
+    comp = _Compactor()
+    read_raw = _extent_reader(path, chunk, n)
+    compact = _compact_stage(comp, shift, precompacted, snapshot=False)
+    fn = _steal_chunk_fn(jax.default_backend(), pos_dtype)
+    results: dict[int, tuple] = {}
+
+    def produce():
+        for k in range(n_chunks):
+            faults.check("trace.read_batch")  # chaos injection site
+            ids, cap_k, _ = compact(k, read_raw(k))
+            if len(ids) < chunk:
+                ids = np.concatenate(
+                    [ids, np.zeros(chunk - len(ids), np.int32)])
+            yield k, (ids, cap_k)
+
+    def run_chunk(wi, k, payload):
+        ids, cap_k = payload
+        dev = devices[wi]
+        out = fn(jax.device_put(ids, dev), npdt.type(k * chunk),
+                 npdt.type(n), int(cap_k))
+        results[k] = tuple(np.asarray(x) for x in out)
+
+    disp = QueueDispatcher(D, run_chunk, depth=D + 2)
+    with _obs.span("trace.shard_replay_file", refs=n, devices=D,
+                   dispatch="steal") as sp:
+        stats = disp.run(produce(), n_chunks)
+        # canonical-order boundary merge (the host twin of the static
+        # path's all_gather + masked-max tail exchange)
+        L = comp.next_free
+        prev = np.full(L, -1, np.int64)
+        hist = np.zeros(NBINS, np.int64)
+        for k in range(n_chunks):
+            h, hp, tp = results.pop(k)
+            hist += np.asarray(h, np.int64)
+            if hp.shape[0] < L:   # chunk ran at a pre-growth capacity
+                pad = np.full(L - hp.shape[0], -1, hp.dtype)
+                hp = np.concatenate([hp, pad])
+                tp = np.concatenate([tp, pad])
+            hp = hp.astype(np.int64)
+            evt = (hp >= 0) & (prev >= 0)
+            hist[0] += int(((hp >= 0) & (prev < 0)).sum())
+            r = (hp - prev)[evt]
+            if r.size:
+                hist += np_head_hist(r)   # the shared binning rule
+            prev = np.where(tp >= 0, tp.astype(np.int64), prev)
+        sp.set(chunks=n_chunks, steals=stats["steals"])
+    _obs.counter_add("shard.chunks", n_chunks)
+    _obs.counter_add("shard.steals", stats["steals"])
+    _obs.counter_add("trace.shard_refs_replayed", n)
+    for i, bf in enumerate(stats["busy_frac"]):
+        _obs.gauge_set(f"shard.device_busy_frac.{i}", round(bf, 4))
+    return ReplayResult(hist, n, comp.next_free)
+
+
 def shard_replay_file(path: str, cls: int = 64, mesh=None,
                       window: int = TRACE_WINDOW,
                       precompacted: bool = False,
@@ -1910,7 +2021,8 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
                       initial_capacity: int = 1 << 20,
                       checkpoint_path: str | None = None,
                       checkpoint_every: int = 4,
-                      resume: bool = False) -> ReplayResult:
+                      resume: bool = False,
+                      dispatch: str | None = None) -> ReplayResult:
     """Device-sharded replay streamed from DISK in bounded host memory.
 
     :func:`shard_replay` holds the whole compacted trace in host RAM —
@@ -1941,6 +2053,15 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
     continues from the recorded call — bit-identical to an uninterrupted
     run.  A checkpoint for a different (file, shape, mesh) identity is
     ignored with a notice, never spliced.
+
+    ``dispatch``: ``steal`` (single-process default — per-device workers
+    pull chunks off a bounded queue fed by the sequential
+    reader+compactor, so a device that finishes early immediately serves
+    the next chunk instead of idling behind the static segment split),
+    ``static`` (the shard_map segment scan — the multi-process mode, and
+    the only mode that checkpoints: the checkpoint identity IS the static
+    segment grid, so ``checkpoint_path`` pins it), or ``auto``/None
+    (``PLUSS_SHARD_DISPATCH``).  Bit-identical either way.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1957,6 +2078,22 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
             "shard_replay_file needs precompacted ids under multi-process "
             "execution (per-process cluster discovery would diverge)"
         )
+    from pluss.parallel.shard import _auto_steal, _resolve_dispatch
+
+    eff = _resolve_dispatch(dispatch)
+    if eff == "auto":
+        eff = "steal" if _auto_steal(_u64_count(path)) else "static"
+    if eff == "steal" and checkpoint_path is not None:
+        if dispatch == "steal":
+            import sys
+
+            print("trace: checkpointing pins the static sharded dispatch "
+                  "(the checkpoint identity is the static segment grid); "
+                  "using dispatch='static'", file=sys.stderr)
+        eff = "static"
+    if eff == "steal" and D > 1:
+        return _shard_replay_file_steal(path, cls, mesh, window,
+                                        precompacted, batch_windows)
     n = _u64_count(path)
     if n == 0:
         return ReplayResult(np.zeros(NBINS, np.int64), 0, 0)

@@ -46,6 +46,20 @@ JAX_PLATFORMS=cpu PLUSS_TELEMETRY="$PLUSS_OBS_LOG" \
 python -m pluss.cli stats "$PLUSS_OBS_LOG" --check 1>&2
 rm -f "$PLUSS_OBS_LOG"
 
+# multichip smoke (tier-1): 8-fake-device sharded execution — streamed
+# sharded replay (work-stealing AND static dispatch) bit-identical to the
+# single-device replay, quad-nest shard_run (cholesky, the straggler-bound
+# window shape) bit-identical to engine.run across steal seeds / window
+# kernels / dispatch modes, with the steal telemetry (shard.chunks /
+# shard.steals counters, per-device busy-fraction gauges) ARMED and the
+# emitted stream gated on `pluss stats --check` — the fleet execution
+# path is proven on every PR, not just in the budget-gated bench.
+PLUSS_MC_LOG=$(mktemp /tmp/pluss_mc_XXXX.jsonl)
+JAX_PLATFORMS=cpu PLUSS_TELEMETRY="$PLUSS_MC_LOG" \
+  python -m pluss.multichip_smoke 1>&2
+python -m pluss.cli stats "$PLUSS_MC_LOG" --check 1>&2
+rm -f "$PLUSS_MC_LOG"
+
 # serve smoke (tier-1): spawn a real `pluss serve` daemon on a unix socket
 # and drive ~20 mixed spec/trace requests through the soak load generator —
 # including a forced-degraded request (injected OOM ridden through the

@@ -57,7 +57,9 @@ def chaos(n_rounds: int, sd: int) -> int:
         prefix="pluss_chaos_cache_")
     from pluss.utils.platform import enable_x64, force_cpu
 
-    force_cpu()
+    # 8 virtual devices: the kill-mid-sweep scenario below runs the sweep
+    # across device groups, like the production fleet path
+    force_cpu(8)
     enable_x64()
     from pluss import engine, obs
     from pluss.config import SamplerConfig
@@ -108,6 +110,7 @@ def chaos(n_rounds: int, sd: int) -> int:
         print(f"chaos[{i}] {name}{n} plan={plan.describe()}: {status}"
               + (f" (degraded: {deg})" if deg else "")
               + f" in {time.perf_counter() - t0:.1f}s", flush=True)
+    failures += _chaos_sweep_kill(sd)
     c = obs.counters()
 
     def breakdown(prefix: str) -> str:
@@ -129,6 +132,81 @@ def chaos(n_rounds: int, sd: int) -> int:
     print(f"chaos soak: {n_rounds} rounds, {failures} failure(s), seed {sd}",
           flush=True)
     return 1 if failures else 0
+
+
+def _chaos_sweep_kill(sd: int) -> int:
+    """Kill a sweep WORKER PROCESS mid-sweep, then assert journaled
+    elastic recovery: the resumed device-group sweep restores every
+    journaled point (ZERO recomputation of finished work), computes only
+    the remainder, and the final curves are bit-identical to a clean
+    serial sweep.  Returns the failure count (0 = pass)."""
+    import os
+    import subprocess
+    import tempfile
+
+    from pluss import obs, sweep as sweep_mod
+    from pluss.config import SamplerConfig
+    from pluss.models import REGISTRY
+    from pluss.resilience.journal import Journal
+
+    ts, cks = (1, 2, 4, 8), (2, 4)
+    total = len(ts) * len(cks)
+    jr_path = os.path.join(tempfile.mkdtemp(prefix="pluss_chaos_sweep_"),
+                           "sweep.jsonl")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PLUSS_FAULT_PLAN", None)
+    env.pop("PLUSS_TELEMETRY", None)   # the child must not truncate ours
+    repo = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pluss.cli", "sweep", "--cpu",
+         "--model", "gemm", "--n", "16",
+         "--sweep-threads", ",".join(map(str, ts)),
+         "--sweep-chunks", ",".join(map(str, cks)),
+         "--journal", jr_path, "--resume", "--device-groups", "2"],
+        cwd=repo, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    # wait for >= 2 journaled points, then SIGKILL — a worker death in
+    # the realistic shape (no cleanup, mid-flight points lost)
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        try:
+            if sum(1 for ln in open(jr_path)) >= 2:
+                break
+        except OSError:
+            pass
+        if proc.poll() is not None:
+            break
+        time.sleep(0.25)
+    killed = proc.poll() is None
+    if killed:
+        proc.kill()
+    proc.wait()
+    if not killed:
+        print("chaos sweep-kill: sweep finished before the kill landed; "
+              "recovery still asserted on the full journal", flush=True)
+    finished = len(Journal(jr_path))
+    c0 = obs.counters()
+    pts = sweep_mod.sweep(REGISTRY["gemm"](16), ts, cks, SamplerConfig(),
+                          journal=jr_path, resume=True, device_groups=2)
+    c1 = obs.counters()
+    restored = int(c1.get("sweep.points_restored", 0)
+                   - c0.get("sweep.points_restored", 0))
+    ran = int(c1.get("sweep.points_run", 0) - c0.get("sweep.points_run", 0))
+    clean = sweep_mod.sweep(REGISTRY["gemm"](16), ts, cks, SamplerConfig())
+    same = all(a.curve.tolist() == b.curve.tolist()
+               and a.total_refs == b.total_refs
+               for a, b in zip(pts, clean))
+    ok = (restored == finished and ran == total - finished and same
+          and len(pts) == total)
+    print(f"chaos sweep-kill: {finished} point(s) journaled before the "
+          f"kill; resumed sweep restored {restored}, recomputed {ran} "
+          f"(zero recompute of finished points: "
+          f"{restored == finished and ran == total - finished}), curves "
+          f"{'bit-identical' if same else 'DIVERGED'} vs clean serial",
+          flush=True)
+    if not ok:
+        print("chaos sweep-kill: FAIL", flush=True)
+    return 0 if ok else 1
 
 
 def serve(n_requests: int, sd: int, chaos: bool,

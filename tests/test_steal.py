@@ -1,0 +1,400 @@
+"""Work-stealing sharded dispatch ≡ static shard_map ≡ vmap engine.
+
+The PR-9 contract: the chunk partition and the canonical-order boundary
+merge are the ONLY things that reach a sharded result — which device ran a
+chunk, in what order, under which steal schedule, and through which window
+kernel (segmented batch_events vs the legacy ghost merge) are all
+bit-identity-invariant.  Pinned here across steal seeds, device counts,
+dispatch modes, and kernels, on affine, ultra+var, and quad (clock-table)
+nests — plus the dispatcher's own scheduling semantics, the iterative
+share-cap retry, the device-group sweep, and the README/stat-block sync.
+"""
+
+import io
+import time
+
+import numpy as np
+import pytest
+
+from pluss.config import SamplerConfig
+from pluss.engine import run
+from pluss.models import REGISTRY, gemm
+from pluss.parallel import default_mesh, shard_run
+from pluss.parallel.steal import QueueDispatcher, StealDispatcher
+
+
+def assert_same(a, b, what=""):
+    assert a.max_iteration_count == b.max_iteration_count, what
+    assert a.noshare_dense.tolist() == b.noshare_dense.tolist(), what
+    assert a.share_raw == b.share_raw, what
+
+
+# ---------------------------------------------------------------------------
+# dispatcher unit semantics (pure host, no jax)
+
+
+def test_steal_dispatcher_runs_every_chunk_once():
+    for n_chunks, n_workers in ((13, 4), (3, 8), (1, 2), (0, 3), (8, 1)):
+        ran = []
+        disp = StealDispatcher(n_chunks, n_workers,
+                               lambda wi, ci: ran.append(ci), seed=0)
+        stats = disp.run()
+        assert sorted(ran) == list(range(n_chunks))
+        assert stats["chunks"] == n_chunks
+        assert sum(stats["chunks_per_worker"]) == n_chunks
+
+
+def test_steal_dispatcher_steals_from_stragglers():
+    # worker 0's chunks are slow: idle workers must steal its tail
+    def run_chunk(wi, ci):
+        time.sleep(0.05 if ci < 8 else 0.001)
+
+    disp = StealDispatcher(16, 2, run_chunk, seed=0)
+    stats = disp.run()
+    assert stats["steals"] >= 1, "no steal despite a straggler-bound deque"
+    assert sorted(stats["ran_by"]) == list(range(16))
+
+
+def test_steal_dispatcher_seed_permutes_schedule_only():
+    # the rotation deal moves chunks between workers deterministically
+    # with the seed (victim tie-breaks add run-time variation on top);
+    # every chunk still runs exactly once whatever the deal
+    deals = set()
+    for seed in range(4):
+        done = []
+        disp = StealDispatcher(12, 3, lambda wi, ci: done.append(ci),
+                               seed=seed)
+        deals.add(tuple(tuple(d) for d in disp._deques))
+        disp.run()
+        assert sorted(done) == list(range(12))
+    assert len(deals) >= 2, "seeds never permuted the chunk->device deal"
+
+
+def test_steal_dispatcher_propagates_worker_error():
+    def boom(wi, ci):
+        if ci == 5:
+            raise RuntimeError("chunk 5 died")
+
+    with pytest.raises(RuntimeError, match="chunk 5"):
+        StealDispatcher(8, 2, boom, seed=0).run()
+
+
+def test_queue_dispatcher_pulls_and_counts_steals():
+    done = []
+    disp = QueueDispatcher(2, lambda wi, ci, payload: done.append(ci),
+                           depth=2)
+    stats = disp.run(((i, None) for i in range(9)), 9)
+    assert sorted(done) == list(range(9))
+    assert stats["chunks"] == 9
+
+
+def test_queue_dispatcher_error_does_not_deadlock():
+    def boom(wi, ci, payload):
+        if ci == 1:
+            raise ValueError("chunk 1 died")
+        time.sleep(0.01)
+
+    with pytest.raises(ValueError, match="chunk 1"):
+        QueueDispatcher(2, boom, depth=1).run(
+            ((i, None) for i in range(50)), 50)
+
+
+def test_queue_dispatcher_producer_error_propagates():
+    def produce():
+        yield 0, None
+        raise OSError("feed died")
+
+    with pytest.raises(OSError, match="feed died"):
+        QueueDispatcher(2, lambda wi, ci, p: None, depth=2).run(
+            produce(), 2)
+
+
+# ---------------------------------------------------------------------------
+# steal dispatch ≡ engine, across seeds / device counts / kernels.
+# Families: affine template (gemm), ultra+var split (syrk), and a QUAD
+# clock-table nest (cholesky) — the straggler-bound shape stealing is for.
+
+STEAL_FAMILIES = [
+    ("gemm16", lambda: gemm(16), SamplerConfig(cls=8)),
+    ("syrk32", lambda: REGISTRY["syrk"](32), SamplerConfig()),
+    ("cholesky16", lambda: REGISTRY["cholesky"](16), SamplerConfig(cls=8)),
+]
+
+
+@pytest.mark.parametrize("name,build,cfg", STEAL_FAMILIES,
+                         ids=[f[0] for f in STEAL_FAMILIES])
+def test_steal_permutations_bit_identical_to_engine(name, build, cfg):
+    spec = build()
+    want = run(spec, cfg)
+    for n_dev, seeds in ((2, (0,)), (4, (0, 3)), (8, (0,))):
+        for seed in seeds:
+            got = shard_run(spec, cfg, mesh=default_mesh(n_dev),
+                            dispatch="steal", steal_seed=seed)
+            assert got.dispatch_stats["dispatch"] == "steal"
+            assert_same(want, got, f"{name} D={n_dev} seed={seed}")
+
+
+def test_steal_segmented_ab_mixed_windows():
+    # gemm(24) on 4 devices: template and sort branches side by side (the
+    # test_parallel mixed-window shape) — both kernels, both = engine
+    cfg = SamplerConfig(cls=8)
+    spec = gemm(24)
+    want = run(spec, cfg)
+    mesh = default_mesh(4)
+    seg = shard_run(spec, cfg, mesh=mesh, dispatch="steal", segmented=True)
+    leg = shard_run(spec, cfg, mesh=mesh, dispatch="steal", segmented=False)
+    assert_same(want, seg, "segmented")
+    assert_same(want, leg, "legacy kernel")
+
+
+def test_shard_static_segmented_ab():
+    # the static shard_map program rides the segmented kernel too; the
+    # legacy ghost-merge stays available for A/B
+    cfg = SamplerConfig()
+    spec = REGISTRY["syrk"](32)
+    want = run(spec, cfg)
+    mesh = default_mesh(4)
+    for segmented in (True, False):
+        got = shard_run(spec, cfg, mesh=mesh, dispatch="static",
+                        segmented=segmented)
+        assert_same(want, got, f"static segmented={segmented}")
+
+
+def test_steal_quad_subwindows_and_resume():
+    # forced sub-windows on a triangular nest: multi-window chunks carry
+    # heads/tails across windows INSIDE a chunk and across chunks
+    spec = REGISTRY["syrk_tri"](16)
+    cfg = SamplerConfig()
+    a = run(spec, cfg, window_accesses=1)
+    b = shard_run(spec, cfg, mesh=default_mesh(2), window_accesses=1,
+                  dispatch="steal")
+    assert_same(a, b, "syrk_tri sub-windows")
+    c = run(gemm(64), cfg, start_point=24)
+    d = shard_run(gemm(64), cfg, mesh=default_mesh(2), start_point=24,
+                  dispatch="steal", window_accesses=1)
+    assert_same(c, d, "start_point resume")
+
+
+def test_steal_share_cap_retry_iterative():
+    """The share-cap overflow retry is a LOOP, not recursion: a cap of 1
+    converges through doubling attempts without touching the recursion
+    limit, bit-identical to the engine (and lands the retry counter)."""
+    import sys
+
+    from pluss import obs
+
+    spec = gemm(16)
+    cfg = SamplerConfig(cls=8)
+    want = run(spec, cfg)
+    old = sys.getrecursionlimit()
+    tel = obs.active()
+    try:
+        sys.setrecursionlimit(120)   # deep retry recursion would die here
+        got = shard_run(spec, cfg, share_cap=1, mesh=default_mesh(2),
+                        dispatch="steal")
+    finally:
+        sys.setrecursionlimit(old)
+    assert got.max_iteration_count == want.max_iteration_count
+    assert (got.noshare_dense == want.noshare_dense).all()
+    assert got.share_list() == want.share_list()
+    if tel is not None:
+        assert obs.counters().get("engine.share_cap_retries", 0) >= 1
+
+
+def test_steal_counters_and_busy_gauges_land(tmp_path):
+    from pluss import obs
+
+    obs.configure(str(tmp_path / "t.jsonl"))
+    try:
+        shard_run(gemm(16), SamplerConfig(cls=8), mesh=default_mesh(4),
+                  dispatch="steal")
+        c, tel = obs.counters(), obs.active()
+        g = tel.gauges()
+        assert c.get("shard.chunks", 0) >= 1
+        assert "shard.steals" in c
+        assert any(k.startswith("shard.device_busy_frac.") for k in g)
+    finally:
+        obs.configure(None)
+
+
+@pytest.mark.slow
+def test_steal_all_registry_families_all_device_counts():
+    """Acceptance sweep: every registry family, D in {1, 2, 4, 8}, steal
+    dispatch ≡ engine.run bit-for-bit (D=1 is the engine-delegation
+    path).  Slow: full tier-2 coverage; tier-1 carries the 3-family
+    subset above."""
+    cfg = SamplerConfig()
+    for name in sorted(REGISTRY):
+        spec = REGISTRY[name]()
+        want = run(spec, cfg)
+        for n_dev in (1, 2, 4, 8):
+            got = shard_run(spec, cfg, mesh=default_mesh(n_dev),
+                            dispatch="steal" if n_dev > 1 else None)
+            assert_same(want, got, f"{name} D={n_dev}")
+
+
+# ---------------------------------------------------------------------------
+# streamed sharded replay through the queue dispatcher
+
+
+def _write_trace(path, lines, shift=6):
+    (np.asarray(lines, np.uint64) << np.uint64(shift)).astype(
+        "<u8").tofile(path)
+
+
+def test_trace_steal_matches_replay_file(tmp_path):
+    from pluss import trace
+
+    rng = np.random.default_rng(11)
+    p = str(tmp_path / "t.bin")
+    _write_trace(p, rng.integers(0, 5000, 40_000, dtype=np.int64))
+    window = 1 << 9
+    a = trace.replay_file(p, window=window)
+    b = trace.shard_replay_file(p, window=window, batch_windows=2,
+                                dispatch="steal")
+    assert a.hist.tolist() == b.hist.tolist()
+    assert a.total_count == b.total_count
+
+
+def test_trace_steal_sparse_clusters_and_ragged_tail(tmp_path):
+    # compactor growth mid-stream (chunks at pre-growth capacities merge
+    # against the final table) + a tail chunk shorter than the chunk size
+    from pluss import trace
+
+    rng = np.random.default_rng(12)
+    p = str(tmp_path / "t.bin")
+    _write_trace(p, np.concatenate([
+        rng.integers(0, 4096, 20_000, dtype=np.int64),
+        (1 << 40) + rng.integers(0, 4096, 12_345, dtype=np.int64)]))
+    a = trace.replay_file(p, window=1 << 9)
+    b = trace.shard_replay_file(p, window=1 << 9, batch_windows=3,
+                                dispatch="steal")
+    assert a.hist.tolist() == b.hist.tolist()
+
+
+def test_trace_checkpoint_pins_static_dispatch(tmp_path, capsys):
+    # checkpointing identity IS the static segment grid: an explicit
+    # steal request downgrades with a notice instead of mis-checkpointing
+    from pluss import trace
+
+    rng = np.random.default_rng(13)
+    p = str(tmp_path / "t.bin")
+    _write_trace(p, rng.integers(0, 3000, 20_000, dtype=np.int64))
+    ck = str(tmp_path / "ck")
+    a = trace.replay_file(p, window=1 << 9)
+    b = trace.shard_replay_file(p, window=1 << 9, batch_windows=2,
+                                dispatch="steal", checkpoint_path=ck)
+    assert a.hist.tolist() == b.hist.tolist()
+    assert "static" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# device-group sweep: parallel == serial, elastic requeue on worker death
+
+
+def test_sweep_device_groups_matches_serial():
+    from pluss import sweep as sweep_mod
+
+    spec = gemm(16)
+    a = sweep_mod.sweep(spec, (1, 2, 4), (2, 4), SamplerConfig())
+    b = sweep_mod.sweep(spec, (1, 2, 4), (2, 4), SamplerConfig(),
+                        device_groups=4)
+    for pa, pb in zip(a, b):
+        assert pa.cfg == pb.cfg
+        assert pa.curve.tolist() == pb.curve.tolist()
+        assert pa.total_refs == pb.total_refs
+
+
+def test_sweep_elastic_requeue_on_worker_death(tmp_path, monkeypatch):
+    import pluss.parallel.shard as shard_mod
+    import pluss.resilience as res_mod
+    from pluss import obs, sweep as sweep_mod
+    from pluss.resilience.errors import PlussError
+
+    real_rr = res_mod.run_resilient
+    real_sr = shard_mod.shard_run
+    died = {"n": 0}
+
+    def die_once(cfg):
+        # FATAL (neither retryable nor degradable): the ladder re-raises
+        # it, so recovery must come from the sweep's elastic requeue —
+        # exactly the worker-death shape
+        if cfg.thread_num == 2 and died["n"] == 0:
+            died["n"] += 1
+            raise PlussError("injected worker death", site="test.sweep")
+
+    def flaky_rr(spec, cfg, share_cap, **kw):
+        die_once(cfg)
+        return real_rr(spec, cfg, share_cap, **kw)
+
+    def flaky_sr(spec, cfg=None, share_cap=None, *a, **kw):
+        die_once(cfg)
+        return real_sr(spec, cfg, share_cap, *a, **kw)
+
+    # a point runs run_resilient (1-device group) or shard_run (multi-
+    # device group) depending on the device split — inject into both
+    monkeypatch.setattr(res_mod, "run_resilient", flaky_rr)
+    monkeypatch.setattr(shard_mod, "shard_run", flaky_sr)
+    obs.configure(str(tmp_path / "t.jsonl"))
+    try:
+        spec = gemm(16)
+        j = str(tmp_path / "j.jsonl")
+        pts = sweep_mod.sweep(spec, (1, 2, 4), (2,), SamplerConfig(),
+                              journal=j, device_groups=2)
+        c = obs.counters()
+    finally:
+        obs.configure(None)
+    assert died["n"] == 1, "the injected death never fired"
+    assert c.get("sweep.elastic_requeues", 0) >= 1
+    clean = sweep_mod.sweep(spec, (1, 2, 4), (2,), SamplerConfig())
+    for pa, pb in zip(clean, pts):
+        assert pa.curve.tolist() == pb.curve.tolist()
+
+
+# ---------------------------------------------------------------------------
+# stats block + README sync
+
+
+def test_stats_shard_breakdown_render():
+    from pluss.obs.stats import shard_breakdown
+
+    counters = {"shard.chunks": 24.0, "shard.steals": 3.0,
+                "engine.share_cap_retries": 1.0}
+    gauges = {"shard.device_busy_frac.0": 0.91,
+              "shard.device_busy_frac.1": 0.88}
+    lines = shard_breakdown(counters, gauges)
+    assert lines[0] == "shard scale-out:"
+    text = "\n".join(lines)
+    assert "chunks dispatched" in text and "24" in text
+    assert "chunks stolen" in text and "12.5%" in text
+    assert "d0=0.91" in text and "d1=0.88" in text
+    assert "share-cap retries" in text
+    assert shard_breakdown({}, {}) == []
+
+
+def test_readme_scaleout_section_in_sync():
+    """README's Scale-out section must name every dispatch knob and every
+    telemetry name the steal path emits — the test-synced-docs discipline
+    the other README sections follow."""
+    import os
+
+    readme = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                               "README.md")).read()
+    assert "## Scale-out" in readme, "README Scale-out section missing"
+    for needle in (
+            "PLUSS_SHARD_DISPATCH", "PLUSS_SHARD_SEGMENTED",
+            "PLUSS_SHARD_CHUNK_WINDOWS", "PLUSS_SHARD_STEAL_SEED",
+            "PLUSS_SHARD_STEAL_MIN_REFS",
+            "--shard-dispatch", "--device-groups",
+            "shard.chunks", "shard.steals", "shard.device_busy_frac",
+            "shard scale-out:",
+            "scaling_efficiency", "multichip_refs_per_sec",
+    ):
+        assert needle in readme, f"README Scale-out out of sync: {needle}"
+
+
+def test_multichip_smoke_wrapper():
+    """The run.sh multichip gate, as a pytest (small sizes)."""
+    from pluss import multichip_smoke
+
+    multichip_smoke.smoke(trace_refs=60_000, window=1 << 11, nest_n=12)
