@@ -162,12 +162,36 @@ def native_baseline_s(n: int) -> float | None:
     return min(times) if times else None
 
 
+def compile_stamp(c0: dict) -> dict:
+    """Metric-line stamp of the compile cost paid since the ``c0``
+    counter snapshot (round r11 on): ``compile_s`` is the XLA compile
+    wall actually spent, ``warm`` records whether the executables came
+    from a cache (AOT sidecar / persistent cache / in-process memo)
+    instead of a fresh compile — so the trajectory shows compile cost
+    per family instead of burying it in warmup log prose."""
+    from pluss import obs
+
+    c1 = obs.counters()
+
+    def d(k: str) -> float:
+        return c1.get(k, 0.0) - c0.get(k, 0.0)
+
+    return {"compile_s": round_keep(d("engine.compile_s"), 3),
+            "warm": bool(d("engine.compiles") == 0)}
+
+
 def timed_reps(step, reps: int, label: str):
-    """(best seconds, last result) of ``reps`` timed calls after one warmup."""
+    """(best seconds, last result, compile stamp) of ``reps`` timed calls
+    after one warmup; the stamp (:func:`compile_stamp`) covers the
+    warmup, where any compile happens."""
+    from pluss import obs
+
+    c0 = obs.counters()
     t0 = time.perf_counter()
     res = step()  # warmup: compile + first run
     log(f"bench: {label} warmup (incl. compile) "
         f"{time.perf_counter() - t0:.2f}s; {res.max_iteration_count} refs/run")
+    cstamp = compile_stamp(c0)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -176,7 +200,7 @@ def timed_reps(step, reps: int, label: str):
     log(f"bench: {label} per-rep {['%.3f' % t for t in times]} s")
     # best-of-reps on BOTH sides: robust to transient host load, which would
     # otherwise inflate (or deflate) the speedup ratio
-    return min(times), res
+    return min(times), res, cstamp
 
 
 def round_keep(v: float | None, nd: int) -> float | None:
@@ -300,8 +324,9 @@ def bench_trace_device(n_lines: int = 4_200_000) -> None:
     import numpy as np
 
     import jax.numpy as jnp
-    from pluss import trace
+    from pluss import obs, trace
 
+    c0 = obs.counters()
     W, B = trace.TRACE_WINDOW, trace.WINDOWS_PER_BATCH
     batch = W * B
     rng = np.random.default_rng(0)
@@ -326,7 +351,8 @@ def bench_trace_device(n_lines: int = 4_200_000) -> None:
 
     refs, dt = measure(True)
     emit("trace_device_scan_refs_per_sec", refs, dt, None,
-         path="trace_device_scan(segmented)", batch_windows=B)
+         path="trace_device_scan(segmented)", batch_windows=B,
+         **compile_stamp(c0))
     if os.environ.get("PLUSS_BENCH_TRACE_AB"):
         refs, dt = measure(False)
         emit("trace_device_scan_legacy_refs_per_sec", refs, dt, None,
@@ -434,8 +460,9 @@ def bench_trace_resident(n_refs: int) -> None:
     separately, so the metric is independent of tunnel h2d weather.  The
     packed-id file is produced once by trace.pack_file and reused across
     rounds via :func:`cached_pack`."""
-    from pluss import trace
+    from pluss import obs, trace
 
+    c0 = obs.counters()
     path = ensure_trace(n_refs)
     meta, staging_cached, packed = cached_pack(path, n_refs)
     if meta is None:
@@ -469,16 +496,18 @@ def bench_trace_resident(n_refs: int) -> None:
          staging_cached=staging_cached,
          pack_fmt=meta["fmt"],
          upload_s=round(stats["upload_s"], 1),
-         upload_mb_s=round(mb / stats["upload_s"], 2))
+         upload_mb_s=round(mb / stats["upload_s"], 2),
+         **compile_stamp(c0))
 
 
 def bench_trace(n_refs: int) -> None:
     """BASELINE config 5: dynamic trace replay at 1e9 refs, streamed from
     disk (pluss.trace.replay_file) vs the native replay_trace on the same
     addresses.  The trace file is generated once and cached in .bench/."""
-    from pluss import trace
+    from pluss import obs, trace
 
     path = ensure_trace(n_refs)
+    c_init = obs.counters()   # compile stamp covers warmup + replay
     # warmup on a short prefix: the prefix discovers the same working set,
     # so the full run below hits the jit cache at the same table shape.
     # (One full timed run, not best-of-N: the tunneled TPU's throughput
@@ -515,7 +544,6 @@ def bench_trace(n_refs: int) -> None:
     # the deadline (1.3x the projected budget) is the backstop for the
     # feed SLOWING mid-run — a pre-run projection cannot see that
     # (observed: projected at ~23 MB/s, finished at ~5 MB/s, 3x over)
-    from pluss import obs
     from pluss.resilience import replay_file_resilient
 
     c0 = obs.counters()
@@ -564,7 +592,7 @@ def bench_trace(n_refs: int) -> None:
     emit(f"trace{n_refs}_replay_refs_per_sec", n_run, best_s, base_s,
          path="trace_stream", degradations=tuple(rep.degradations),
          refs_replayed=n_run, refs_requested=n_refs,
-         shrunk=bool(n_run != n_refs), **obs_extra)
+         shrunk=bool(n_run != n_refs), **compile_stamp(c_init), **obs_extra)
 
 
 def bench_multichip(trace_refs: int) -> None:
@@ -695,6 +723,133 @@ def bench_serve(n_requests: int = 48) -> None:
         }), flush=True)
 
 
+#: child of the cold/warm A/B: one fresh process, one full run, counters
+#: on stdout.  ``engine.run`` (not the ladder) so the measured wall is
+#: plan + compile + execute with nothing absorbing a failure silently.
+_WARMSTART_CHILD = r"""
+import json, os, sys, time
+from pluss.utils.platform import enable_x64
+enable_x64()
+from pluss import engine, obs
+from pluss.models import gemm
+obs.configure(os.environ["PLUSS_TELEMETRY"])
+n = int(sys.argv[1])
+t0 = time.perf_counter()
+res = engine.run(gemm(n))
+wall = time.perf_counter() - t0
+c = obs.counters()
+print(json.dumps({
+    "first_dispatch_s": wall,
+    "compile_s": c.get("engine.compile_s", 0.0),
+    "aot_hit": c.get("engine.plan_cache.aot_hit", 0.0),
+    "aot_load_fail": c.get("engine.plan_cache.aot_load_fail", 0.0),
+    "refs": int(res.max_iteration_count)}))
+obs.flush_metrics()
+"""
+
+
+def bench_warmstart(n: int, cpu: bool) -> None:
+    """Cold vs warm process start A/B (round r11 on): the same model's
+    first-dispatch wall — plan + XLA compile + execute — in two FRESH
+    subprocesses sharing one plan-cache directory (the multichip --bench
+    subprocess discipline).  The first process is fully cold (fresh
+    cache dir, no persistent XLA cache) and populates the AOT executable
+    sidecars; the second restores them, so the pair records exactly what
+    the warm-start layer buys a new daemon/worker/CLI process.  On a
+    CPU-only box the A/B runs at a smaller n (the flagship size cannot
+    execute on host), named accordingly — a measurement, not a dry run."""
+    import shutil
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="pluss_warmstart_")
+
+    def run_child(tag: str) -> dict:
+        env = {**os.environ,
+               "PLUSS_PLAN_CACHE_DIR": cache_dir,
+               "PLUSS_TELEMETRY": f".bench/warmstart_{tag}.jsonl"}
+        # isolate the layer under test: the sidecars must carry the warm
+        # start alone, not a shared persistent XLA cache
+        for k in ("PLUSS_XLA_CACHE_DIR", "PLUSS_XPROF", "PLUSS_PROM"):
+            env.pop(k, None)
+        if cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, "-c", _WARMSTART_CHILD, str(n)],
+            env=env, capture_output=True, text=True,
+            timeout=max(120, min(int(remaining_s()), 900)), check=True)
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    try:
+        cold = run_child("cold")
+        warm = run_child("warm")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    ratio = cold["first_dispatch_s"] / warm["first_dispatch_s"] \
+        if warm["first_dispatch_s"] else None
+    log(f"bench: warmstart gemm{n}: cold {cold['first_dispatch_s']:.2f}s "
+        f"(compile {cold['compile_s']:.2f}s) vs warm "
+        f"{warm['first_dispatch_s']:.2f}s (compile {warm['compile_s']:.2f}s,"
+        f" {int(warm['aot_hit'])} sidecar hit(s)) -> {ratio:.2f}x")
+    for tag, rec, vs in (("cold", cold, None), ("warm", warm, ratio)):
+        print(json.dumps({
+            "metric": f"gemm{n}_{tag}_start_s",
+            "value": round_keep(rec["first_dispatch_s"], 3),
+            "unit": "s",
+            "vs_baseline": round_keep(vs, 3),
+            "path": "engine.run(fresh process)" + ("+cpu" if cpu else ""),
+            "degradations": [],
+            "compile_s": round_keep(rec["compile_s"], 3),
+            "warm": bool(rec["aot_hit"] > 0),
+            "aot_hit": int(rec["aot_hit"]),
+            "aot_load_fail": int(rec["aot_load_fail"]),
+            "refs": rec["refs"],
+        }), flush=True)
+
+
+def bench_serve_warm(n: int = 64) -> None:
+    """What ``--warm`` buys a daemon's FIRST tenant (round r11 on): start
+    an in-process server with background warmup, wait for warm_done, and
+    measure the very first request's client-side latency — the cold-start
+    SLO number the serving story was missing."""
+    import tempfile
+
+    from pluss import obs
+    from pluss.serve import Client, ServeConfig, Server
+
+    sock = tempfile.mktemp(prefix="pluss_bench_servewarm_", suffix=".sock")
+    srv = Server(socket_path=sock,
+                 config=ServeConfig(warm=f"gemm:{n}", max_batch=8))
+    srv.start()
+    try:
+        deadline = time.monotonic() + max(
+            60, min(remaining_s() * 0.5, 600))
+        while time.monotonic() < deadline:
+            c = obs.counters()
+            if c.get("serve.warmed", 0) + c.get("serve.warm_fail", 0) >= 1:
+                break
+            time.sleep(0.2)
+        with Client(sock) as cl:
+            t0 = time.perf_counter()
+            r = cl.request({"model": "gemm", "n": n})
+            ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        srv.shutdown()
+    if not r.get("ok"):
+        raise RuntimeError(f"serve warm first request failed: {r}")
+    warmed = bool(obs.counters().get("serve.warmed", 0))
+    log(f"bench: serve --warm first request {ms:.1f} ms "
+        f"(warmed={warmed})")
+    print(json.dumps({
+        "metric": "serve_warm_first_request_ms",
+        "value": round_keep(ms, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "path": "serve(--warm gemm)",
+        "degradations": [],
+        "warmed": warmed,
+    }), flush=True)
+
+
 def bench_import(reps: int = 3) -> None:
     """Frontend ingestion throughput (round r08 on): parse + lower +
     share-span derivation + PR-1 analyzer gate for the checked-in
@@ -729,8 +884,6 @@ def main() -> int:
     # persistent XLA compilation cache: the flagship compiles cost minutes
     # over the tunnel; caching them in-repo makes repeat bench runs (and the
     # driver's round-end run on this same box) warm-start in seconds
-    import jax
-
     from pluss.utils.platform import enable_x64
 
     enable_x64()
@@ -742,10 +895,10 @@ def main() -> int:
 
     if not obs.enabled():
         obs.configure(".bench/telemetry.jsonl")
-    os.makedirs(".bench/jit_cache", exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.abspath(".bench/jit_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    from pluss import plancache
+
+    plancache.arm_xla_cache(os.path.abspath(".bench/jit_cache"),
+                            min_compile_s=5.0)
     plat = probe_accelerator()
     if plat is None:
         from pluss.utils.platform import force_cpu
@@ -773,13 +926,13 @@ def main() -> int:
         return step
 
     if plat is None:
-        best_s, res = timed_reps(step_of(gemm(128)), REPS, "gemm128")
+        best_s, res, cstamp = timed_reps(step_of(gemm(128)), REPS, "gemm128")
         emit("gemm128_sampler_refs_per_sec_cpu_fallback",
              res.max_iteration_count, best_s,
              cached_native_s("gemm128", lambda: native_baseline_s(128)),
              path=engine.describe_path(gemm(128)),
              degradations=tuple(res.degradations),
-             spec_source="registry",
+             spec_source="registry", **cstamp,
              **analysis_fields(gemm(128)))
         try:
             bench_serve(24)
@@ -789,6 +942,16 @@ def main() -> int:
             bench_import()
         except Exception as e:
             log(f"bench: import metric failed: {e}")
+        if budget_ok("warmstart", 180):
+            try:
+                bench_warmstart(128, cpu=True)
+            except Exception as e:
+                log(f"bench: warmstart metric failed: {e}")
+        if budget_ok("serve_warm", 90):
+            try:
+                bench_serve_warm(24)
+            except Exception as e:
+                log(f"bench: serve warm metric failed: {e}")
         if budget_ok("multichip", 240):
             try:
                 bench_multichip(
@@ -807,7 +970,9 @@ def main() -> int:
     flagship = None
     flagship_extra: dict = {}
     try:
-        best_s, res = timed_reps(step_of(gemm(1024)), REPS, "gemm1024")
+        best_s, res, cstamp = timed_reps(step_of(gemm(1024)), REPS,
+                                         "gemm1024")
+        flagship_extra.update(cstamp)
         try:  # label-only: must never sink an already-measured flagship
             flag_path = engine.describe_path(gemm(1024))
         except Exception as e:
@@ -822,8 +987,8 @@ def main() -> int:
         # and must never stand between a measured flagship and its
         # emission (the rc=124 precedent) — the re-emission at the end
         # carries the stamped version
-        emit(*flagship, spec_source="registry")
-        flagship_extra = analysis_fields(gemm(1024))
+        emit(*flagship, spec_source="registry", **cstamp)
+        flagship_extra.update(analysis_fields(gemm(1024)))
     except Exception as e:
         log(f"bench: FLAGSHIP gemm1024 metric failed: {e}")
 
@@ -837,14 +1002,14 @@ def main() -> int:
     if budget_ok("syrk1024", 90):
         try:
             n_syrk = 1024
-            best_s, res = timed_reps(step_of(syrk(n_syrk)), 2,
-                                     f"syrk{n_syrk}")
+            best_s, res, cstamp = timed_reps(step_of(syrk(n_syrk)), 2,
+                                             f"syrk{n_syrk}")
             emit(f"syrk{n_syrk}_sortpath_refs_per_sec",
                  res.max_iteration_count, best_s,
                  native_s_of("syrk1024", syrk(n_syrk)),
                  path=engine.describe_path(syrk(n_syrk)),
                  degradations=tuple(res.degradations),
-                 spec_source="registry",
+                 spec_source="registry", **cstamp,
                  **analysis_fields(syrk(n_syrk)))
         except Exception as e:  # never let an aux metric sink the record
             log(f"bench: syrk metric failed: {e}")
@@ -859,13 +1024,14 @@ def main() -> int:
             # default backend: engine auto-reroutes this over-ceiling plan
             # to the dispatch-sliced vmap path (r3's single-executable
             # multi-thread variants all killed the tunneled worker)
-            best_s, res = timed_reps(step_of(spec_tri), 1, "syrktri1024")
+            best_s, res, cstamp = timed_reps(step_of(spec_tri), 1,
+                                             "syrktri1024")
             emit("syrktri1024_sortpath_refs_per_sec",
                  res.max_iteration_count, best_s,
                  native_s_of("syrktri1024", spec_tri),
                  path=engine.describe_path(spec_tri),
                  degradations=tuple(res.degradations),
-                 spec_source="registry",
+                 spec_source="registry", **cstamp,
                  **analysis_fields(spec_tri))
         except Exception as e:
             log(f"bench: triangular metric failed: {e}")
@@ -898,6 +1064,21 @@ def main() -> int:
             bench_multichip(trace_refs)
         except Exception as e:
             log(f"bench: multichip metric failed: {e}")
+
+    # warm-start headlines (round r11 on): what the persistent AOT
+    # executable cache buys a FRESH process — cold vs warm first-dispatch
+    # wall in two subprocesses sharing one plan-cache dir, plus the first
+    # request latency of a --warm'ed daemon
+    if budget_ok("warmstart", 300):
+        try:
+            bench_warmstart(1024, cpu=False)
+        except Exception as e:
+            log(f"bench: warmstart metric failed: {e}")
+    if budget_ok("serve_warm", 120):
+        try:
+            bench_serve_warm(64)
+        except Exception as e:
+            log(f"bench: serve warm metric failed: {e}")
 
     # serving headline (round r07 on): what a tenant of `pluss serve`
     # experiences — p50/p99 latency and req/s, batched vs unbatched A/B

@@ -473,6 +473,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--prom-refresh-s", type=float, default=5.0,
                    help="serve mode: SLO gauge + prometheus textfile "
                         "(PLUSS_PROM) refresh period")
+    p.add_argument("--warm", default=None, metavar="MODELS",
+                   help="serve mode: background-precompile these models at "
+                        "daemon start (comma-separated "
+                        "name[:n[:threads[:chunk]]] entries, or 'all' for "
+                        "every registry model) so first requests dispatch "
+                        "warm")
+    p.add_argument("--xla-cache", default=None, metavar="DIR",
+                   help="arm JAX's persistent compilation cache in DIR "
+                        "(default $PLUSS_XLA_CACHE_DIR when set): compiled "
+                        "HLO survives process death, on top of the plan "
+                        "cache's AOT executable sidecars")
     p.add_argument("--run", action="store_true",
                    help="import / spec-load mode: after the analyzer "
                         "gate, run the derived spec through the engine "
@@ -553,6 +564,10 @@ def main(argv: list[str] | None = None) -> int:
         return _lint_main(args, sys.stdout, cfg)
 
     def setup_platform() -> None:
+        from pluss import plancache
+
+        # arm before any compile: --xla-cache, else $PLUSS_XLA_CACHE_DIR
+        plancache.arm_xla_cache(args.xla_cache)
         if args.cpu:
             from pluss.utils.platform import force_cpu
 
@@ -598,6 +613,7 @@ def main(argv: list[str] | None = None) -> int:
             prom_refresh_s=args.prom_refresh_s,
             heartbeat_dir=args.heartbeat_dir,
             num_processes=args.num_processes,
+            warm=args.warm,
         )
         server = Server(socket_path=args.socket, port=args.port,
                         host=args.host, config=scfg)

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pluss import obs
+from pluss import obs, plancache
 from pluss.config import DEFAULT, NBINS, SHARE_CAP, SamplerConfig
 from pluss.obs import xprof
 from pluss.ops.reuse import (
@@ -148,36 +148,45 @@ def plan_cache_max() -> int:
 def _plan_cache_evict() -> None:
     """Evict least-recently-USED entries past :func:`plan_cache_max`.
 
-    Recency is file mtime: :func:`_plan_cache_get` touches an entry on
-    every hit, so a warm daemon's hot plans never age out while one-off
-    requests' artifacts do.  Concurrent writers may race the listing —
-    a missing file mid-evict is someone else's eviction, not an error."""
+    An ENTRY is a key GROUP: the plan pickle plus any AOT executable
+    sidecars sharing its key prefix (``<key>.pkl`` + ``<key>.aot-*.exe``
+    — :mod:`pluss.plancache`).  The cap counts groups, recency is the
+    group's newest member mtime (:func:`_plan_cache_get` and
+    ``plancache.aot_load`` both touch on hit), and a group evicts as ONE
+    unit — a sidecar can never orphan its plan pickle or outlive it, so
+    the executable artifacts cannot grow the cache dir unboundedly.
+    Concurrent writers may race the listing — a missing file mid-evict
+    is someone else's eviction, not an error."""
     cap = plan_cache_max()
     if cap <= 0:
         return
     root = _plan_cache_root()
     if root is None:
         return
+    groups: dict[str, list[tuple[float, str]]] = {}
     try:
-        entries = []
         with os.scandir(root) as it:
             for de in it:
-                if de.name.endswith(".pkl"):
-                    try:
-                        entries.append((de.stat().st_mtime, de.path))
-                    except OSError:
-                        continue
+                if not de.name.endswith((".pkl", ".exe")):
+                    continue   # .corrupt quarantines and .tmp.* stay out
+                try:
+                    mtime = de.stat().st_mtime
+                except OSError:
+                    continue
+                groups.setdefault(de.name.split(".", 1)[0],
+                                  []).append((mtime, de.path))
     except OSError:
         return
-    if len(entries) <= cap:
+    if len(groups) <= cap:
         return
-    entries.sort()
-    for _, path in entries[: len(entries) - cap]:
-        try:
-            os.unlink(path)
-            obs.counter_add("engine.plan_cache.evict")
-        except OSError:
-            continue
+    ranked = sorted(groups.values(), key=lambda ms: max(m for m, _ in ms))
+    for members in ranked[: len(groups) - cap]:
+        for _, path in members:
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+        obs.counter_add("engine.plan_cache.evict")
 
 
 def _plan_cache_get(key: str):
@@ -775,6 +784,7 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         )
 
     nests: list[NestPlan] = []
+    exe_group: str | None = None   # AOT sidecar group key (plancache)
     iters = np.zeros((len(spec.nests), T), np.int64)
     acc = np.zeros((len(spec.nests), T), np.int64)  # true accesses per thread
     for ni, (sched, refs, body, asg, owned, W, NW) in enumerate(geom):
@@ -815,6 +825,13 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
             clean = _clean_windows(owned, W, NW, cfg.chunk_size, sched.trip)
             cache_key = _plan_cache_key(
                 spec, cfg, ni, W, NW) if start_point is None else None
+            if ni == 0 and cache_key and assignment is None:
+                # AOT executable sidecars group under the FIRST nest's
+                # plan-cache key when the whole plan is default-scheduled,
+                # so eviction unlinks an entry's executables with its
+                # pickle; custom assignments fall back to an independent
+                # group hash (stamped by _plan_cached)
+                exe_group = cache_key
             cached = _plan_cache_get(cache_key) if cache_key else None
             tpl_refs, split_var = _split_ref_groups(refs, sched, cfg)
             if tpl_refs:
@@ -926,7 +943,7 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     total = int(acc.sum())
 
     check_sort_budget(nests, spec, cfg, pos_dtype, sort_concurrency)
-    return StreamPlan(
+    pl = StreamPlan(
         spec=spec,
         cfg=cfg,
         nests=tuple(nests),
@@ -935,6 +952,9 @@ def plan(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         total_count=total,
         pos_dtype=pos_dtype,
     )
+    if exe_group is not None:
+        object.__setattr__(pl, "_exe_group", exe_group)
+    return pl
 
 
 def check_sort_budget(nests, spec: LoopNestSpec, cfg: SamplerConfig,
@@ -1440,6 +1460,76 @@ def _dispatch_entry_budget() -> int:
     return int(os.environ.get("PLUSS_MAX_DISPATCH_ENTRIES", 1 << 28))
 
 
+def _slice_schedule(pl: StreamPlan, cfg: SamplerConfig,
+                    thread_batch: int | None,
+                    budget: int) -> list[tuple[int, int, list]]:
+    """The sliced runner's dispatch schedule: ``(ni, si, w_sub)`` window
+    slices in execution order.  Factored out of :func:`run_sliced` so
+    :func:`precompile` warms exactly the slice executables the real run
+    will request — same segments, same slice lengths."""
+    n_lines = pl.spec.total_lines(cfg)
+    conc = thread_batch or cfg.thread_num
+    out: list[tuple[int, int, list]] = []
+    for ni, np_ in enumerate(pl.nests):
+        for si, (is_ultra, w_list, brefs) in enumerate(_segments_of(np_)):
+            epw = _segment_entries_per_window(np_, cfg, n_lines,
+                                              is_ultra, brefs)
+            wpd = max(1, min(len(w_list), budget // max(1, epw * conc)))
+            for lo in range(0, len(w_list), wpd):
+                out.append((ni, si, w_list[lo:lo + wpd]))
+    return out
+
+
+#: in-process single-flight compile registry: concurrent builds of one
+#: key (serve's device loop racing the --warm thread, sweep workers) run
+#: ONCE; waiters share the result or the same typed failure.  The serve
+#: SLO publisher exports its depth as the ``serve.compile_inflight`` gauge.
+_compile_registry = plancache.CompileRegistry(
+    gauge="engine.compile_inflight")
+
+
+def compile_inflight() -> int:
+    """Compiles currently in flight in the single-flight registry."""
+    return _compile_registry.inflight()
+
+
+#: executable keys built in THIS process — a warm/cold scheduling hint
+#: for the serve loop (a false negative costs one off-thread warm,
+#: never correctness).  Cleared with the executable memos.
+_warm_keys: set = set()
+
+
+def _aot_executable(pl: StreamPlan, fn, example_args: tuple,
+                    slot_parts: tuple, donate: tuple = ()):
+    """AOT-compile ``fn`` at ``example_args`` (ShapeDtypeStructs),
+    restoring from / persisting to the plan cache's executable sidecar
+    (:mod:`pluss.plancache`) when the plan has a group key and the
+    backend can serialize.  Returns a callable bit-identical to
+    ``jax.jit(fn, donate_argnums=donate)`` at exactly those shapes.
+    Actual compile seconds land in the ``engine.compile_s`` counter —
+    deserialized restores add none, which is the recorded warm-start
+    win."""
+    import time as _time
+
+    jf = jax.jit(fn, donate_argnums=donate)
+    path = plancache.aot_path(getattr(pl, "_exe_group", None), slot_parts)
+    exe = plancache.aot_load(path)
+    if exe is not None:
+        return exe
+    t0 = _time.perf_counter()
+    try:
+        exe = jf.lower(*example_args).compile()
+    except Exception:  # noqa: BLE001 — AOT quirks never take down a run
+        # the lazy jit path compiles the identical program on first call
+        obs.counter_add("engine.aot_lower_fail")
+        return jf
+    obs.counter_add("engine.compiles")
+    obs.counter_add("engine.compile_s", _time.perf_counter() - t0)
+    if path is not None:
+        plancache.aot_save(path, exe)
+    return exe
+
+
 def _slice_fn(pl: StreamPlan, share_cap: int, ni: int, si: int,
               slice_len: int, thread_batch: int | None):
     # the executable cache lives ON the plan object (a frozen dataclass, so
@@ -1459,6 +1549,17 @@ def _slice_fn(pl: StreamPlan, share_cap: int, ni: int, si: int,
            jax.default_backend())
     if key in cache:
         return cache[key]
+    # single-flight: a serve --warm precompile racing the device loop (or
+    # two sweep workers sharing one plan memo) builds this slice once
+    return _compile_registry.do(
+        ("slice", id(pl)) + key,
+        lambda: _slice_fn_build(pl, cache, key, share_cap, ni, si,
+                                slice_len, thread_batch))
+
+
+def _slice_fn_build(pl: StreamPlan, cache: dict, key: tuple,
+                    share_cap: int, ni: int, si: int, slice_len: int,
+                    thread_batch: int | None):
     pdt = jnp.dtype(pl.pos_dtype)
 
     def f(tids, last_pos, hist, w_ids):
@@ -1478,7 +1579,16 @@ def _slice_fn(pl: StreamPlan, share_cap: int, ni: int, si: int,
     # donate the carries so the [T, n_lines] table stays in place on device
     # across dispatches (CPU backend: donation unsupported, would warn)
     donate = (1, 2) if jax.default_backend() != "cpu" else ()
-    fn = jax.jit(f, donate_argnums=donate)
+    T = pl.cfg.thread_num
+    n_lines = pl.spec.total_lines(pl.cfg)
+    fn = _aot_executable(
+        pl, f,
+        (jax.ShapeDtypeStruct((T,), jnp.int32),
+         jax.ShapeDtypeStruct((T, n_lines), pdt),
+         jax.ShapeDtypeStruct((T, NBINS), pdt),
+         jax.ShapeDtypeStruct((slice_len,), jnp.int32)),
+        ("slice", ni, si, slice_len, thread_batch, share_cap),
+        donate=donate)
     cache[key] = fn
     return fn
 
@@ -1491,8 +1601,24 @@ def _plan_cached(spec: LoopNestSpec, cfg: SamplerConfig, assignment,
     plan inside its cache entry)."""
     with obs.span("engine.plan", model=spec.name,
                   threads=cfg.thread_num, chunk=cfg.chunk_size):
-        return plan(spec, cfg, assignment, start_point, window_accesses,
-                    sort_concurrency=sort_concurrency)
+        pl = plan(spec, cfg, assignment, start_point, window_accesses,
+                  sort_concurrency=sort_concurrency)
+    _stamp_exe_group(pl, (spec, cfg, assignment, start_point,
+                          window_accesses))
+    return pl
+
+
+def _stamp_exe_group(pl: StreamPlan, identity: tuple) -> None:
+    """Give a plan WITHOUT a nest-0 plan-cache key (triangular/quad
+    nests, custom assignments, resume points) an independent AOT sidecar
+    group keyed on the full plan identity + the analysis-source salt, so
+    its executables persist too — just not co-grouped with a pickle."""
+    if getattr(pl, "_exe_group", None) is None:
+        import hashlib
+
+        object.__setattr__(pl, "_exe_group", hashlib.sha256(
+            repr((_plan_cache_salt(),) + identity).encode()
+        ).hexdigest()[:32])
 
 
 @functools.lru_cache(maxsize=32)
@@ -1511,9 +1637,16 @@ def shard_plan_cached(spec: LoopNestSpec, cfg: SamplerConfig, assignment,
     ``var_refs``)."""
     with obs.span("engine.plan", model=spec.name, threads=cfg.thread_num,
                   chunk=cfg.chunk_size, backend="shard"):
-        return plan(spec, cfg, assignment, start_point, window_accesses,
-                    n_windows=n_windows, build_overlays=False,
-                    build_rowpriv=False)
+        pl = plan(spec, cfg, assignment, start_point, window_accesses,
+                  n_windows=n_windows, build_overlays=False,
+                  build_rowpriv=False)
+    # shard plans NEVER share a group with the default-grid plans: the
+    # n_windows grid (and the overlay-free analysis) changes the program
+    if getattr(pl, "_exe_group", None) is not None:
+        object.__setattr__(pl, "_exe_group", None)
+    _stamp_exe_group(pl, ("shard", spec, cfg, assignment, start_point,
+                          window_accesses, n_windows))
+    return pl
 
 
 def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
@@ -1557,7 +1690,6 @@ def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     n_lines = spec.total_lines(cfg)
     pdt = np.dtype(pl.pos_dtype)
     budget = max_dispatch_entries or _dispatch_entry_budget()
-    conc = thread_batch or T
 
     tids = jnp.arange(T, dtype=jnp.int32)
     last_pos = jnp.full((T, n_lines), -1, pdt)
@@ -1566,25 +1698,20 @@ def run_sliced(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     n_dispatches = 0
     with obs.span("engine.dispatch", model=spec.name, backend="sliced",
                   thread_batch=thread_batch or T) as sp, xprof.session():
-        for ni, np_ in enumerate(pl.nests):
-            for si, (is_ultra, w_list, brefs) in enumerate(
-                    _segments_of(np_)):
-                epw = _segment_entries_per_window(np_, cfg, n_lines,
-                                                  is_ultra, brefs)
-                wpd = max(1, min(len(w_list), budget // max(1, epw * conc)))
-                for lo in range(0, len(w_list), wpd):
-                    sub = w_list[lo:lo + wpd]
-                    fn = _slice_fn(pl, share_cap, ni, si, len(sub),
-                                   thread_batch)
-                    with xprof.annotate(
-                            f"pluss.engine.{spec.name}.n{ni}s{si}"):
-                        last_pos, hist, flat = fn(
-                            tids, last_pos, hist,
-                            jnp.asarray(sub, jnp.int32))
-                    parts[ni].append((len(sub), flat))
-                    n_dispatches += 1
+        for ni, si, sub in _slice_schedule(pl, cfg, thread_batch, budget):
+            fn = _slice_fn(pl, share_cap, ni, si, len(sub),
+                           thread_batch)
+            with xprof.annotate(
+                    f"pluss.engine.{spec.name}.n{ni}s{si}"):
+                last_pos, hist, flat = fn(
+                    tids, last_pos, hist,
+                    jnp.asarray(sub, jnp.int32))
+            parts[ni].append((len(sub), flat))
+            n_dispatches += 1
         hist_np = np.asarray(hist)   # the fetch forces every dispatch
         sp.set(dispatches=n_dispatches)
+    _warm_keys.add(("sliced", spec, cfg, share_cap, assignment,
+                    start_point, window_accesses))
     obs.counter_add("engine.sliced_dispatches", n_dispatches)
     obs.counter_add("engine.refs_processed", pl.total_count)
     share_ys = []
@@ -1637,13 +1764,20 @@ def compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
 
     Normalizes ``thread_batch`` BEFORE the memo lookup so equivalent values
     (e.g. ``cfg.thread_num`` vs ``None``) share one compiled executable
-    (advisor r3)."""
+    (advisor r3).
+
+    Concurrent callers for one cold key (serve's device loop racing the
+    --warm thread) SINGLE-FLIGHT through the compile registry: one build,
+    every waiter answered — or all rejected with the same typed error."""
     from pluss.resilience import faults
 
     faults.check("engine.compile")   # chaos injection site
-    return _compiled(spec, cfg, share_cap, assignment, start_point,
-                     window_accesses, backend,
-                     _normalize_thread_batch(thread_batch, cfg))
+    key = (spec, cfg, share_cap, assignment, start_point,
+           window_accesses, backend, _normalize_thread_batch(thread_batch,
+                                                             cfg))
+    out = _compile_registry.do(key, lambda: _compiled(*key))
+    _warm_keys.add(("exe",) + key)
+    return out
 
 
 @functools.lru_cache(maxsize=64)
@@ -1664,7 +1798,14 @@ def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
             if thread_batch:
                 return jax.lax.map(g, tids, batch_size=thread_batch)
             return jax.vmap(g)(tids)
-        return pl, jax.jit(f)
+        # eager AOT compile (restored from the executable sidecar when the
+        # plan cache holds one for this runtime): run() always calls with
+        # tids = arange(thread_num, int32), so the example shape IS the
+        # only shape this executable ever sees
+        exe = _aot_executable(
+            pl, f, (jax.ShapeDtypeStruct((cfg.thread_num,), jnp.int32),),
+            ("vmap", share_cap, thread_batch))
+        return pl, exe
     if backend == "seq":
         one = jax.jit(lambda t: _thread_pipeline_packed(t, pl, share_cap))
 
@@ -1683,6 +1824,7 @@ def _clear_compiled_caches() -> None:
     _compiled.cache_clear()
     _plan_cached.cache_clear()
     shard_plan_cached.cache_clear()
+    _warm_keys.clear()
 
 
 #: tests and tools clear the executable memo through the public name
@@ -2004,6 +2146,66 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         new_cap = _auto_share_cap(e, share_cap)
         return run(spec, cfg, new_cap, assignment, start_point,
                    window_accesses, backend, thread_batch)
+
+
+def precompile(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
+               share_cap: int = SHARE_CAP, assignment=None,
+               start_point=None, window_accesses=None,
+               thread_batch: int | None = None) -> str:
+    """Warm every executable :func:`run` would dispatch, without running.
+
+    Mirrors run()'s auto-dispatch decision, so the warmed artifacts are
+    exactly what the real request will ask for: the single vmap
+    executable, or the per-segment slice executables of the dispatch-
+    sliced path.  Compiles land in the in-process memos AND (when the
+    plan cache is armed and the backend serializes) the disk sidecars,
+    all through the single-flight registry — a real request racing this
+    warmup waits on the in-flight compile instead of duplicating it.
+    Returns the path warmed (``'vmap'`` or ``'sliced'``).
+
+    Callers: ``pluss serve --warm`` at daemon start, the serve loop's
+    off-thread compile of a parked cold batch, and the sweep's
+    precompile phase (point k+1 compiles while point k executes)."""
+    if assignment is not None:
+        assignment = tuple(
+            tuple(a) if a is not None else None for a in assignment
+        )
+    tb = _normalize_thread_batch(thread_batch, cfg)
+    with obs.span("engine.precompile", model=spec.name,
+                  threads=cfg.thread_num, chunk=cfg.chunk_size):
+        if not os.environ.get("PLUSS_NO_AUTO_DISPATCH"):
+            pl = _plan_cached(spec, cfg, assignment, start_point,
+                              window_accesses, 1)
+            decision = _auto_dispatch(pl, cfg, tb)
+            if decision is not None:
+                tb2, _ = decision
+                check_sort_budget(pl.nests, spec, cfg, pl.pos_dtype, tb2)
+                seen: set = set()
+                for ni, si, sub in _slice_schedule(
+                        pl, cfg, tb2, _dispatch_entry_budget()):
+                    if (ni, si, len(sub)) in seen:
+                        continue
+                    seen.add((ni, si, len(sub)))
+                    _slice_fn(pl, share_cap, ni, si, len(sub), tb2)
+                _warm_keys.add(("sliced", spec, cfg, share_cap,
+                                assignment, start_point, window_accesses))
+                return "sliced"
+        compiled(spec, cfg, share_cap, assignment, start_point,
+                 window_accesses, "vmap", tb)
+        return "vmap"
+
+
+def is_warm(spec: LoopNestSpec, cfg: SamplerConfig,
+            share_cap: int = SHARE_CAP,
+            window_accesses: int | None = None) -> bool:
+    """Whether a serving-shaped request (default assignment/start_point/
+    thread_batch) would find its executables already built in THIS
+    process.  A scheduling HINT for the serve loop — a false negative
+    costs one redundant off-thread warm; correctness never depends on
+    it."""
+    tail = (spec, cfg, int(share_cap), None, None, window_accesses)
+    return ("exe",) + tail + ("vmap", None) in _warm_keys \
+        or ("sliced",) + tail in _warm_keys
 
 
 def _auto_share_cap(e: ShareCapExceeded, share_cap: int) -> int:

@@ -311,6 +311,49 @@ def serve_breakdown(counters: dict[str, float],
     return lines
 
 
+def warmstart_breakdown(counters: dict[str, float],
+                        gauges: dict[str, float]) -> list[str]:
+    """The warm-start block: XLA compile seconds actually paid by this
+    process, AOT executable sidecar hit/miss/restore-failure traffic,
+    single-flight dedup, and serve/sweep warmup activity.  Empty when the
+    stream has no compile or AOT activity at all (a fully warm process
+    that restored nothing shows its aot_hit count here)."""
+    keys = ("engine.compiles", "engine.compile_s",
+            "engine.plan_cache.aot_hit", "engine.plan_cache.aot_miss",
+            "engine.plan_cache.aot_load_fail")
+    if not any(counters.get(k) for k in keys):
+        return []
+    lines = ["warm start:"]
+    comp = counters.get("engine.compiles", 0.0)
+    comp_s = counters.get("engine.compile_s", 0.0)
+    lines.append(f"  {'compiles paid':<28} {int(comp):>9}  "
+                 f"({comp_s:.3f}s wall)")
+    hit = counters.get("engine.plan_cache.aot_hit", 0.0)
+    miss = counters.get("engine.plan_cache.aot_miss", 0.0)
+    fail = counters.get("engine.plan_cache.aot_load_fail", 0.0)
+    lines.append(f"  {'aot exe hit/miss/load_fail':<28} "
+                 f"{int(hit):>9} / {int(miss)} / {int(fail)}")
+    waits = counters.get("engine.compile_singleflight_waits")
+    if waits:
+        lines.append(f"  {'single-flight dedup waits':<28} {int(waits):>9}")
+    warmed = counters.get("serve.warmed")
+    if warmed or counters.get("serve.warm_fail"):
+        wf = counters.get("serve.warm_fail", 0.0)
+        lines.append(f"  {'serve warmup (ok / fail)':<28} "
+                     f"{int(warmed or 0):>9} / {int(wf)}")
+    parked = counters.get("serve.compile_parked")
+    if parked:
+        lines.append(f"  {'serve batches parked':<28} {int(parked):>9}")
+    pre = counters.get("sweep.precompiles")
+    if pre:
+        lines.append(f"  {'sweep points precompiled':<28} {int(pre):>9}")
+    infl = gauges.get("serve.compile_inflight")
+    if infl is not None:
+        lines.append(f"  {'compile in flight (last)':<28} "
+                     f"{_fmt_val(infl):>9}")
+    return lines
+
+
 def shard_breakdown(counters: dict[str, float],
                     gauges: dict[str, float]) -> list[str]:
     """The multi-chip scale-out block: chunk dispatch volume, how much
@@ -383,6 +426,9 @@ def render(records: list[dict], out) -> None:
     sblock = serve_breakdown(counters, gauges)
     if sblock:
         out.write("\n".join(sblock) + "\n")
+    wblock = warmstart_breakdown(counters, gauges)
+    if wblock:
+        out.write("\n".join(wblock) + "\n")
     shblock = shard_breakdown(counters, gauges)
     if shblock:
         out.write("\n".join(shblock) + "\n")

@@ -522,7 +522,17 @@ def _chunk_fn(pl: StreamPlan, share_cap: int, ni: int, L: int,
         return _nest_results(pl.nests[ni], ni, tids, pl, share_cap, w_ids,
                              segmented, vary=lambda tree: tree)
 
-    fn = jax.jit(f)
+    # lazy AOT over the jit: the executable is committed to ``device`` by
+    # its first call's arg placement (an eager ShapeDtypeStruct lower would
+    # pin device 0), so lowering waits for the concrete call; a restored
+    # sidecar that refuses the call (device-binding mismatch after a
+    # topology change) falls back to the jit path per call_fallback
+    from pluss import plancache
+
+    fn = plancache.LazyAotFn(
+        jax.jit(f), getattr(pl, "_exe_group", None),
+        ("chunk", ni, L, segmented, share_cap, device.id),
+        call_fallback=True)
     cache[key] = fn
     return fn
 

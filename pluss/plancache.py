@@ -1,0 +1,377 @@
+"""Warm-start layer: runtime salt, XLA persistent-cache arming, AOT
+executable sidecars beside the disk plan cache, and a single-flight
+compile registry.
+
+Why a second artifact class: the disk plan cache (:mod:`pluss.engine`)
+persists HOST-side analysis only (WindowTemplate + verified OverlayPlans)
+— the XLA executables themselves lived in per-process memos, so every
+fresh process (a ``pluss serve`` daemon start, each sweep worker, every
+CLI run) re-paid seconds-to-tens of compile before its first useful
+dispatch (BENCH_r05: gemm1024 warmup incl. compile 5.77 s against
+0.488 s steady-state reps).  This module gives compiled executables the
+same disk persistence and hygiene the plan artifacts already have:
+
+- :func:`runtime_salt` — jax version + backend + device kind + the NBINS
+  grid constant.  Serialized executables are PJRT-runtime-specific in a
+  way host-side plans are not, so AOT sidecars carry the runtime
+  identity ON TOP of the plan-source hash; a jax upgrade or a backend
+  switch can never load a stale executable, while plain plan entries
+  keep the cheaper source-only salt.
+- :func:`aot_save` / :func:`aot_load` — sidecar slots
+  (``<group>.aot-<slot>.exe``) beside the plan pickles: written
+  atomically, chaos-corruptible and quarantined exactly like the
+  pickles (the PR-2 ``plan_cache.get`` fault site), and LRU-evicted as
+  one group with their plan pickle (``engine._plan_cache_evict``).
+- :func:`aot_supported` — one serialize/pickle/deserialize round-trip
+  probe per backend; a PJRT runtime that cannot deserialize degrades
+  every caller to plain JIT (bit-identical results, just cold), with
+  ``engine.plan_cache.aot_load_fail`` counting the failed restores.
+- :class:`CompileRegistry` — in-process single-flight: N concurrent
+  requests for one key run ONE build; waiters share the result or the
+  SAME raised exception, so an in-flight compile failure rejects every
+  waiter with the identical typed error.  (``functools.lru_cache`` does
+  NOT dedupe concurrent builds — two threads racing a cold key both
+  trace and compile.)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+from pluss import obs
+
+
+def runtime_salt() -> str:
+    """Runtime identity of the ACTIVE backend's serialized executables.
+
+    Folded into every AOT sidecar slot (path hash AND payload, belt and
+    braces): a deserialized executable is only valid on the exact PJRT
+    runtime that produced it, so the salt pins jax version, backend,
+    device kind, and the histogram grid constant the kernels bake in.
+    Plan pickles deliberately do NOT use this — they are host math,
+    portable across jax versions, and keyed by the source hash alone
+    (``engine._plan_cache_salt``)."""
+    import jax
+
+    return _runtime_salt(jax.default_backend())
+
+
+@functools.lru_cache(maxsize=None)
+def _runtime_salt(backend: str) -> str:
+    import jax
+
+    from pluss.config import NBINS
+
+    try:
+        kind = jax.devices(backend)[0].device_kind
+    except Exception:
+        kind = "unknown"
+    return f"jax={jax.__version__}/{backend}/{kind}/nbins={NBINS}"
+
+
+def arm_xla_cache(path: str | None = None,
+                  min_compile_s: float | None = None) -> str | None:
+    """Arm JAX's persistent compilation cache (the HLO->binary layer
+    below the AOT sidecars — it dedupes compiles across DIFFERENT plan
+    keys that lower to equal HLO, and covers backends the sidecar probe
+    rejects).  Directory: ``path`` arg, else ``PLUSS_XLA_CACHE_DIR``;
+    returns the armed directory or None when unset.  The min-compile-time
+    floor (``PLUSS_XLA_CACHE_MIN_COMPILE_S``, default 1.0 s) keeps tier-1
+    fast: trivial test kernels never pay the cache-write fsync."""
+    import jax
+
+    path = path or os.environ.get("PLUSS_XLA_CACHE_DIR")
+    if not path:
+        return None
+    os.makedirs(path, exist_ok=True)
+    if min_compile_s is None:
+        min_compile_s = float(
+            os.environ.get("PLUSS_XLA_CACHE_MIN_COMPILE_S", 1.0))
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_s))
+    return path
+
+
+def aot_supported() -> bool:
+    """Whether this process can serialize AND restore executables on the
+    active backend — probed once per backend with a trivial kernel.
+    ``PLUSS_NO_AOT=1`` force-disables (sidecar reads and writes both)."""
+    if os.environ.get("PLUSS_NO_AOT"):
+        return False
+    import jax
+
+    return _aot_probe(jax.default_backend())
+
+
+@functools.lru_cache(maxsize=None)
+def _aot_probe(backend: str) -> bool:
+    import pickle
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        from jax.experimental import serialize_executable as se
+
+        exe = jax.jit(lambda x: x + 1).lower(
+            jax.ShapeDtypeStruct((2,), jnp.int32)).compile()
+        blob = pickle.dumps(se.serialize(exe))
+        restored = se.deserialize_and_load(*pickle.loads(blob))
+        return bool(
+            (np.asarray(restored(jnp.zeros(2, jnp.int32))) == 1).all())
+    except Exception as e:  # noqa: BLE001 — degrade to JIT, loudly, once
+        import sys
+
+        print(f"pluss: AOT executable cache disabled on backend "
+              f"{backend!r} ({type(e).__name__}: {e}); executables will "
+              "JIT per process", file=sys.stderr)
+        return False
+
+
+def aot_path(group: str | None, parts: tuple) -> str | None:
+    """Disk slot for one serialized executable, or None when the plan
+    cache is off or the plan has no stable group key.  ``group`` is the
+    owning plan-cache entry's key (sidecars of one entry share its
+    prefix, so eviction unlinks them as a unit); ``parts`` identify the
+    executable within the group (backend path, segment, slice length,
+    thread batch, share cap)."""
+    if group is None:
+        return None
+    from pluss import engine
+
+    root = engine._plan_cache_root()
+    if root is None:
+        return None
+    import hashlib
+
+    slot = hashlib.sha256(
+        repr((runtime_salt(),) + parts).encode()).hexdigest()[:16]
+    os.makedirs(root, exist_ok=True)
+    return os.path.join(root, f"{group}.aot-{slot}.exe")
+
+
+def aot_load(path: str | None):
+    """Restore a serialized executable from its sidecar, or None.
+
+    Counter discipline (``engine.plan_cache.*``): ``aot_hit`` on a
+    successful restore (recency touched for the LRU, like plan hits),
+    ``aot_miss`` when the slot is empty or carries a different runtime
+    salt (a stale-but-wellformed entry is a miss — the fresh compile
+    overwrites it), ``aot_load_fail`` (+ ``corrupt`` for bad bytes) when
+    the slot exists but cannot be restored — those quarantine to
+    ``*.corrupt`` exactly like plan pickles, so a poisoned sidecar is
+    paid once, not every process start."""
+    if path is None or not aot_supported():
+        return None
+    if not os.path.exists(path):
+        obs.counter_add("engine.plan_cache.aot_miss")
+        return None
+    import pickle
+
+    from pluss.resilience import faults
+    from pluss.resilience.errors import quarantine_artifact
+
+    faults.corrupt("plan_cache.get", path)   # chaos: corrupt_cache site
+    try:
+        with open(path, "rb") as f:
+            salt, ser, in_tree, out_tree = pickle.load(f)
+    except Exception as e:  # noqa: BLE001 — quarantine, degrade to JIT
+        obs.counter_add("engine.plan_cache.corrupt")
+        obs.counter_add("engine.plan_cache.aot_load_fail")
+        quarantine_artifact(path, "AOT executable sidecar", e,
+                            action="recompiling")
+        return None
+    if salt != runtime_salt():
+        obs.counter_add("engine.plan_cache.aot_miss")
+        return None
+    try:
+        from jax.experimental import serialize_executable as se
+
+        exe = se.deserialize_and_load(ser, in_tree, out_tree)
+    except Exception as e:  # noqa: BLE001 — PJRT refused the bytes
+        obs.counter_add("engine.plan_cache.aot_load_fail")
+        quarantine_artifact(path, "AOT executable sidecar", e,
+                            action="recompiling")
+        return None
+    obs.counter_add("engine.plan_cache.aot_hit")
+    try:
+        os.utime(path)   # refresh the GROUP's LRU recency
+    except OSError:
+        pass
+    return exe
+
+
+def aot_save(path: str | None, exe) -> bool:
+    """Serialize ``exe`` into its sidecar slot (atomic tmp + rename, the
+    plan pickles' write discipline).  Best-effort: serialization refusals
+    are counted (``aot_save_fail``) and swallowed — the in-process memo
+    still has the executable; only the NEXT process stays cold."""
+    if path is None or not aot_supported():
+        return False
+    import pickle
+    import uuid
+
+    try:
+        from jax.experimental import serialize_executable as se
+
+        ser, in_tree, out_tree = se.serialize(exe)
+        payload = (runtime_salt(), ser, in_tree, out_tree)
+        tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    except Exception:  # noqa: BLE001 — cold next process, not an error
+        obs.counter_add("engine.plan_cache.aot_save_fail")
+        return False
+    from pluss import engine
+
+    engine._plan_cache_evict()
+    return True
+
+
+class LazyAotFn:
+    """Per-shape AOT wrapper around a jitted fn whose shapes (or device
+    placement) are only known at call time.
+
+    Eager AOT (``engine._aot_executable``) lowers from ShapeDtypeStructs
+    and suits fns with one static signature on the default device.  This
+    wrapper instead lowers from the FIRST CONCRETE CALL per argument
+    signature — capturing committed-device placement and ad-hoc shapes
+    (the trace replay step's growing line table, per-device shard chunk
+    executables) — then restores/saves the executable through the same
+    sidecar slots.  Any AOT failure degrades that signature to the plain
+    jitted fn: bit-identical, just cold.  ``call_fallback=True`` also
+    retries a restored executable's call-time refusal (e.g. a PJRT
+    device-binding mismatch after a topology change) through the jit
+    path once, then pins the fallback."""
+
+    def __init__(self, jf, group: str | None, parts: tuple,
+                 call_fallback: bool = False):
+        self._jf = jf
+        self._group = group
+        self._parts = parts
+        self._call_fallback = call_fallback
+        self._exes: dict = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _sig(a):
+        shp = getattr(a, "shape", None)
+        dt = getattr(a, "dtype", None)
+        if shp is None or dt is None:
+            return type(a).__name__
+        return (tuple(shp), str(dt))
+
+    def _resolve(self, sig, args):
+        with self._lock:
+            exe = self._exes.get(sig)
+            if exe is not None:
+                return exe
+            path = aot_path(self._group, self._parts + (sig,))
+            exe = aot_load(path)
+            if exe is None:
+                import time as _time
+
+                t0 = _time.perf_counter()
+                try:
+                    exe = self._jf.lower(*args).compile()
+                except Exception:  # noqa: BLE001 — degrade to plain JIT
+                    obs.counter_add("engine.aot_lower_fail")
+                    self._exes[sig] = self._jf
+                    return self._jf
+                obs.counter_add("engine.compiles")
+                obs.counter_add("engine.compile_s",
+                                _time.perf_counter() - t0)
+                if path is not None:
+                    aot_save(path, exe)
+            self._exes[sig] = exe
+            return exe
+
+    def __call__(self, *args):
+        sig = tuple(self._sig(a) for a in args)
+        exe = self._resolve(sig, args)
+        if exe is self._jf or not self._call_fallback:
+            return exe(*args)
+        try:
+            return exe(*args)
+        except Exception:  # noqa: BLE001 — restored exe refused the call
+            obs.counter_add("engine.aot_call_fail")
+            with self._lock:
+                self._exes[sig] = self._jf
+            return self._jf(*args)
+
+
+class _Flight:
+    __slots__ = ("done", "result", "exc")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result = None
+        self.exc: BaseException | None = None
+
+
+class CompileRegistry:
+    """Single-flight deduplication of concurrent expensive builds.
+
+    The first caller for a key is the LEADER and runs ``build()``;
+    callers arriving while that build is in flight block on it and
+    receive the leader's result — or the leader's exception object
+    re-raised, so a failed compile rejects every waiter with the same
+    typed error instead of each waiter retrying the doomed compile.
+    Entries are dropped on completion: failures are never cached (the
+    next cold caller retries fresh) and results live in the caller's own
+    memo (``engine._compiled``'s lru, the on-plan slice caches), so the
+    registry holds no long-lived references.
+    """
+
+    def __init__(self, gauge: str | None = None):
+        self._lock = threading.Lock()
+        self._inflight: dict = {}
+        self._gauge = gauge
+
+    def inflight(self) -> int:
+        """Builds currently in flight (the serve SLO publisher exports
+        this as the ``serve.compile_inflight`` gauge)."""
+        with self._lock:
+            return len(self._inflight)
+
+    def _publish(self) -> None:
+        if self._gauge:
+            obs.gauge_set(self._gauge, float(len(self._inflight)))
+
+    def do(self, key, build):
+        """Return ``build()``'s value for ``key``, building at most once
+        across concurrent callers.  Do not nest ``do`` calls for one key
+        inside ``build`` (the leader would wait on itself)."""
+        with self._lock:
+            fl = self._inflight.get(key)
+            leader = fl is None
+            if leader:
+                fl = _Flight()
+                self._inflight[key] = fl
+                self._publish()
+        if not leader:
+            obs.counter_add("engine.compile_singleflight_waits")
+            fl.done.wait()
+            if fl.exc is not None:
+                raise fl.exc
+            return fl.result
+        try:
+            fl.result = build()
+            return fl.result
+        except BaseException as e:
+            fl.exc = e
+            raise
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+                self._publish()
+            fl.done.set()

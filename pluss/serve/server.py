@@ -70,6 +70,50 @@ class ServeConfig:
     prom_refresh_s: float = 5.0   # SLO gauge + textfile refresh cadence
     heartbeat_dir: str | None = None   # arm the fleet-health exporter
     num_processes: int | None = None   # heartbeat worker count
+    #: background warmup at daemon start (``--warm``): comma-separated
+    #: ``name[:n[:threads[:chunk]]]`` entries, or ``all`` for every
+    #: registry model at the default warm size — see :func:`_warm_objs`
+    warm: str | None = None
+
+
+#: ``--warm`` entry defaults (small enough to compile fast, large enough
+#: that the compiled shapes match real small-request traffic)
+_WARM_N, _WARM_THREADS, _WARM_CHUNK = 16, 4, 4
+
+
+def _warm_objs(text: str) -> list[dict]:
+    """Expand a ``--warm`` value into request objects for
+    :func:`~pluss.serve.protocol.parse_request`.
+
+    Going THROUGH the wire parser is the point: warmup must build the
+    exact (spec, cfg, share_cap, window) a real request would carry —
+    including protocol defaults like ``cache_kb`` that differ from
+    :class:`SamplerConfig`'s — or the warmed executables would sit in
+    memo slots no live request ever keys into."""
+    out = []
+    for entry in text.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if entry == "all":
+            from pluss.models import REGISTRY
+
+            out.extend({"model": m, "n": _WARM_N, "threads": _WARM_THREADS,
+                        "chunk": _WARM_CHUNK, "id": f"warm-{m}"}
+                       for m in REGISTRY)
+            continue
+        parts = entry.split(":")
+        if len(parts) > 4:
+            raise ValueError(
+                f"--warm entry {entry!r}: expected name[:n[:threads[:chunk]]]")
+        name = parts[0]
+        nums = [int(p) for p in parts[1:]]
+        n = nums[0] if len(nums) > 0 else _WARM_N
+        threads = nums[1] if len(nums) > 1 else _WARM_THREADS
+        chunk = nums[2] if len(nums) > 2 else _WARM_CHUNK
+        out.append({"model": name, "n": n, "threads": threads,
+                    "chunk": chunk, "id": f"warm-{name}-{n}"})
+    return out
 
 
 class Server:
@@ -100,6 +144,11 @@ class Server:
         self._slo_lock = threading.Lock()
         self._responses = 0
         self._last_publish = 0.0
+        # batches parked while their plan variant compiles off-thread:
+        # batch_key -> (requests, compile-done event).  Touched only from
+        # the device loop (park/collect) and _bg_compile (event set).
+        self._park_lock = threading.Lock()
+        self._parked: dict = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -137,6 +186,44 @@ class Server:
                 self.config.heartbeat_dir,
                 self.config.num_processes or 1,
                 interval_s=self.config.prom_refresh_s)
+        if self.config.warm:
+            t = threading.Thread(target=self._warm_loop,
+                                 name="pluss-serve-warm", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _warm_loop(self) -> None:
+        """Background warmup: precompile each ``--warm`` entry's plan
+        variants so the first real request dispatches warm.  Runs OFF the
+        device loop (the daemon serves while warming); the single-flight
+        registry dedupes against any request that races a warm entry.
+        Failures are counted + evented, never fatal — a bad entry leaves
+        that model cold, nothing else."""
+        from pluss import engine
+
+        warmed = 0
+        try:
+            objs = _warm_objs(self.config.warm)
+        except Exception as e:  # noqa: BLE001 — malformed --warm value
+            obs.counter_add("serve.warm_fail")
+            obs.event("serve.warm_error", entry=self.config.warm,
+                      error=str(e))
+            return
+        for obj in objs:
+            if self._stopping.is_set():
+                return
+            try:
+                req = parse_request(obj)
+                with obs.span("serve.warm", model=obj.get("model")):
+                    engine.precompile(req.spec, req.cfg, req.share_cap,
+                                      window_accesses=req.window)
+                warmed += 1
+                obs.counter_add("serve.warmed")
+            except Exception as e:  # noqa: BLE001 — entry-local failure
+                obs.counter_add("serve.warm_fail")
+                obs.event("serve.warm_error", entry=repr(obj),
+                          error=f"{type(e).__name__}: {e}")
+        obs.event("serve.warm_done", warmed=warmed)
 
     @property
     def address(self) -> str:
@@ -313,15 +400,83 @@ class Server:
 
     def _device_loop(self) -> None:
         while True:
+            self._run_ready_parked()
             batch, expired = self.batcher.next_batch(timeout=0.25)
             for req in expired:
                 self._respond_deadline(req)
             if not batch:
                 if self._stopping.is_set() and len(self.queue) == 0:
+                    if self._parked:
+                        # drain must answer parked members too: wait out
+                        # their compiles and execute before declaring done
+                        self._run_ready_parked(wait=True)
+                        continue
                     self._drained.set()
                     return
                 continue
+            if self._maybe_park(batch):
+                continue
             self._execute(batch)
+
+    def _maybe_park(self, batch: list[Request]) -> bool:
+        """Keep the device loop draining while a cold key compiles.
+
+        A spec batch whose plan variants are not yet warm — and with
+        OTHER keys waiting in the queue — parks behind an off-thread
+        ``engine.precompile`` instead of pinning the device loop on an
+        inline compile; the loop keeps serving warm keys meanwhile.  A
+        later batch for the same key joins the parked members (the
+        single dispatch answers all).  With nothing else to do, or
+        during drain, the batch compiles inline as before."""
+        lead = batch[0]
+        if lead.kind != "spec" or self._stopping.is_set():
+            return False
+        key = lead.batch_key()
+        with self._park_lock:
+            parked = self._parked.get(key)
+            if parked is not None:
+                parked[0].extend(batch)
+                obs.counter_add("serve.compile_parked", len(batch))
+                return True
+        from pluss import engine
+
+        if engine.is_warm(lead.spec, lead.cfg, lead.share_cap,
+                          window_accesses=lead.window):
+            return False
+        if not self.queue.has_other_work(key):
+            return False   # the loop would idle anyway: compile inline
+        done = threading.Event()
+        with self._park_lock:
+            self._parked[key] = (list(batch), done)
+        obs.counter_add("serve.compile_parked", len(batch))
+        threading.Thread(target=self._bg_compile, args=(lead, done),
+                         name="pluss-serve-compile", daemon=True).start()
+        return True
+
+    def _bg_compile(self, lead: Request, done: threading.Event) -> None:
+        from pluss import engine
+
+        try:
+            engine.precompile(lead.spec, lead.cfg, lead.share_cap,
+                              window_accesses=lead.window)
+        except Exception:  # noqa: BLE001 — the real dispatch will surface
+            # a typed per-request error through the ladder; the parked
+            # batch must still execute, so a compile failure only counts
+            obs.counter_add("serve.compile_bg_fail")
+        finally:
+            done.set()
+
+    def _run_ready_parked(self, wait: bool = False) -> None:
+        with self._park_lock:
+            items = list(self._parked.items())
+        for key, (reqs, done) in items:
+            if wait:
+                done.wait()
+            elif not done.is_set():
+                continue
+            with self._park_lock:
+                self._parked.pop(key, None)
+            self._execute(reqs)
 
     def _execute(self, batch: list[Request]) -> None:
         # members can expire between batching and dispatch
@@ -462,6 +617,10 @@ class Server:
         if p99 is not None:
             obs.gauge_set("serve.p99_ms", round(p99, 3))
         obs.gauge_set("serve.queue_depth", float(len(self.queue)))
+        from pluss import engine
+
+        obs.gauge_set("serve.compile_inflight",
+                      float(engine.compile_inflight()))
 
     def _slo_loop(self) -> None:
         interval = max(self.config.prom_refresh_s, 0.1)

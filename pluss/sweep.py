@@ -123,6 +123,43 @@ def _sweep_point(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
         return mrc.aet_mrc(ri, cfg), refs, degradations
 
 
+def _precompile_point(spec: LoopNestSpec, cfg: SamplerConfig,
+                      share_cap: int) -> None:
+    from pluss import engine, obs
+
+    try:
+        with obs.span("sweep.precompile", model=spec.name,
+                      threads=cfg.thread_num, chunk=cfg.chunk_size):
+            engine.precompile(spec, cfg, share_cap)
+        obs.counter_add("sweep.precompiles")
+    except Exception:  # noqa: BLE001 — best-effort: the point itself
+        # compiles inline (and surfaces any real error) if this fails
+        obs.counter_add("sweep.precompile_fail")
+
+
+def _spawn_precompile(spec: LoopNestSpec, cfg: SamplerConfig,
+                      share_cap: int, journal, resume: bool):
+    """Start compiling the NEXT point's plan variants while the current
+    point executes.  The single-flight compile registry makes the overlap
+    safe: if the next point arrives while its compile is still in flight
+    it waits on that one compile instead of duplicating it.  Skipped for
+    points a resume journal will restore (nothing will dispatch), and
+    under ``PLUSS_NO_PRECOMPILE=1``."""
+    import os
+    import threading
+
+    if os.environ.get("PLUSS_NO_PRECOMPILE"):
+        return None
+    if journal is not None and resume \
+            and journal.get(_point_key(spec, cfg)) is not None:
+        return None
+    t = threading.Thread(target=_precompile_point,
+                         args=(spec, cfg, share_cap),
+                         name="pluss-sweep-precompile", daemon=True)
+    t.start()
+    return t
+
+
 def sweep(spec: LoopNestSpec,
           thread_nums: Sequence[int] = (1, 2, 4, 8),
           chunk_sizes: Sequence[int] = (4,),
@@ -161,7 +198,10 @@ def sweep(spec: LoopNestSpec,
         return _sweep_parallel(spec, cfgs, share_cap, journal, resume,
                                device_groups)
     out = []
-    for cfg in cfgs:
+    for k, cfg in enumerate(cfgs):
+        # precompile phase: point k+1's compile overlaps point k's execute
+        if k + 1 < len(cfgs):
+            _spawn_precompile(spec, cfgs[k + 1], share_cap, journal, resume)
         curve, refs, degradations = _sweep_point(spec, cfg, share_cap,
                                                  journal, resume)
         out.append(SweepPoint(cfg, curve, refs, degradations))

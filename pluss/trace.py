@@ -628,9 +628,29 @@ def _segmented_batch(last_pos, hist, base, ids, n_valid, pdt):
     return last_pos, hist + event_histogram(ev)
 
 
+@functools.lru_cache(maxsize=None)
+def _trace_cache_salt() -> str:
+    """Source identity of the replay kernel for AOT sidecar grouping.
+
+    ``engine._plan_cache_salt`` deliberately excludes this module (loop-
+    nest plans don't depend on it), so trace sidecars carry their own
+    source hash: an edit to the replay step or the reuse kernels
+    invalidates every persisted trace executable."""
+    import hashlib
+
+    h = hashlib.sha256()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in ("trace.py", os.path.join("ops", "reuse.py")):
+        with open(os.path.join(here, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
 @functools.lru_cache(maxsize=32)
 def _replay_fn_cached(window: int, pos_dtype_name: str, backend: str,
                       segmented: bool):
+    import hashlib
+
     pdt = jnp.dtype(pos_dtype_name)
 
     def run(last_pos, hist, base, ids, n_valid):
@@ -642,7 +662,15 @@ def _replay_fn_cached(window: int, pos_dtype_name: str, backend: str,
     # batches; the CPU backend does not support donation and would warn once
     # per batch, so donate only off-CPU (there the copy is cheap anyway)
     donate = (0, 1) if backend != "cpu" else ()
-    return jax.jit(run, donate_argnums=donate)
+    group = hashlib.sha256(repr(
+        (_trace_cache_salt(), "trace", window, pos_dtype_name, segmented)
+    ).encode()).hexdigest()[:32]
+    # per-shape AOT over the jit: the replay step retraces on table growth
+    # / --batch-windows, so each signature gets its own sidecar slot
+    from pluss import plancache
+
+    return plancache.LazyAotFn(jax.jit(run, donate_argnums=donate), group,
+                               ("trace", window, pos_dtype_name, segmented))
 
 
 def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,

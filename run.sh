@@ -73,6 +73,35 @@ JAX_PLATFORMS=cpu python soak.py --serve 20 "${PLUSS_SERVE_SEED:-20260804}" \
 python -m pluss.cli stats "$PLUSS_SERVE_LOG" --check 1>&2
 rm -f "$PLUSS_SERVE_LOG"
 
+# warm-start smoke (tier-1): the persistent AOT executable cache, proven
+# across PROCESS boundaries — two fresh subprocesses run the same small
+# model sharing one plan-cache dir.  The first (cold) populates the
+# executable sidecars; the second (warm) must restore them: its telemetry
+# must show >= 1 plan_cache.aot_hit with engine.compile_s ~ 0 (no XLA
+# recompile), and the stream must pass the schema check.  This is the
+# r11 gate: a stale-salt bug, a broken sidecar load, or a silent JIT
+# fallback all fail the driver here, not in production.
+PLUSS_WARM_DIR=$(mktemp -d /tmp/pluss_warm_XXXX)
+PLUSS_WARM_LOG=$(mktemp /tmp/pluss_warm_XXXX.jsonl)
+JAX_PLATFORMS=cpu PLUSS_PLAN_CACHE_DIR="$PLUSS_WARM_DIR" \
+  python -c "from pluss.utils.platform import enable_x64; enable_x64(); \
+from pluss import engine; from pluss.models import gemm; \
+engine.run(gemm(48))" 1>&2
+JAX_PLATFORMS=cpu PLUSS_PLAN_CACHE_DIR="$PLUSS_WARM_DIR" \
+  PLUSS_TELEMETRY="$PLUSS_WARM_LOG" \
+  python -c "from pluss.utils.platform import enable_x64; enable_x64(); \
+import os; from pluss import engine, obs; from pluss.models import gemm; \
+obs.configure(os.environ['PLUSS_TELEMETRY']); engine.run(gemm(48)); \
+c = obs.counters(); \
+assert c.get('engine.plan_cache.aot_hit', 0) >= 1, \
+    f'warm process restored no AOT executable: {c}'; \
+assert c.get('engine.compile_s', 0.0) < 0.05, \
+    f'warm process still paid XLA compile: {c}'; \
+obs.flush_metrics(); print('warm-start smoke: aot_hit=%d compile_s=%.3f' \
+    % (c.get('engine.plan_cache.aot_hit'), c.get('engine.compile_s', 0.0)))" 1>&2
+python -m pluss.cli stats "$PLUSS_WARM_LOG" --check 1>&2
+rm -rf "$PLUSS_WARM_DIR" "$PLUSS_WARM_LOG"
+
 # opt-in chaos smoke (PLUSS_CHAOS=1): a short seeded fault-plan soak on the
 # CPU backend — every injected fault (OOM / compile / share-cap / corrupt
 # cache) must either recover to a bit-exact result via the degradation

@@ -254,9 +254,11 @@ def serve(n_requests: int, sd: int, chaos: bool,
 
         fault_plan = FaultPlan.random(sd, n_faults=2).describe()
     else:
-        # fixed early OOM: the FIRST engine dispatch of the daemon fails
-        # injected and must recover through the serve ladder
-        fault_plan = "oom@1"
+        # fixed early OOM: an EARLY engine dispatch of the daemon fails
+        # injected and must recover through the serve ladder.  @2, not @1:
+        # hit 1 is phase 0's warm-SLO request, whose latency must stay a
+        # clean measurement — the shed burst right after it takes the hit.
+        fault_plan = "oom@2"
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PLUSS_FAULT_PLAN": fault_plan,
            "PLUSS_PLAN_CACHE_DIR": os.path.join(tmp, "plan_cache")}
@@ -265,7 +267,8 @@ def serve(n_requests: int, sd: int, chaos: bool,
     daemon = subprocess.Popen(
         [sys.executable, "-m", "pluss.cli", "serve", "--socket", sock,
          "--cpu", "--telemetry", tel, "--max-batch", "8",
-         "--max-queue", str(max_queue), "--max-delay-ms", "25"],
+         "--max-queue", str(max_queue), "--max-delay-ms", "25",
+         "--warm", "gemm:16:2:2"],
         cwd=os.path.dirname(os.path.abspath(__file__)),
         env=env, stderr=open(err_path, "w"))
     print(f"serve soak seed {sd}: daemon pid {daemon.pid}, fault plan "
@@ -280,6 +283,40 @@ def serve(n_requests: int, sd: int, chaos: bool,
         print(open(err_path).read()[-2000:])
         return 1
     try:
+        # ---- phase 0: warm-start SLO.  The daemon came up with
+        # --warm gemm:16:2:2 (pool[0]'s exact shape); wait for the
+        # background warmup to land, then time the daemon's very FIRST
+        # request.  A warmed daemon must answer it near steady state —
+        # within 2x the steady p50 measured at the end of the run
+        # (asserted under the deterministic plan only; a random chaos
+        # fault may legitimately slow any request it lands on).
+        warm_deadline = time.monotonic() + 120
+        warm_ok = False
+        while time.monotonic() < warm_deadline:
+            try:
+                with open(tel) as fh:
+                    txt = fh.read()
+            except FileNotFoundError:
+                txt = ""
+            if '"serve.warm_error"' in txt:
+                break
+            if '"serve.warm_done"' in txt:
+                warm_ok = True
+                break
+            if daemon.poll() is not None:
+                break
+            time.sleep(0.2)
+        if not warm_ok:
+            print("serve soak: FAIL — daemon never reported warm_done")
+            failures += 1
+        with Client(sock) as c0:
+            tq0 = time.perf_counter()
+            first_resp = c0.request(dict(pool[0], output="both"))
+            first_ms = (time.perf_counter() - tq0) * 1e3
+        if not first_resp.get("ok"):
+            print(f"serve soak: FAIL — warm first request got {first_resp}")
+            failures += 1
+
         # ---- phase 1: force a shed (typed Overloaded, never a crash)
         holder = Client(sock)
         hid = holder.send({"sleep_ms": 1200})
@@ -373,6 +410,14 @@ def serve(n_requests: int, sd: int, chaos: bool,
         burst_q = dict(pool[0], output="both")
         bk = key_of(burst_q)
         solo[bk] = solo_payload(burst_q)
+        if first_resp.get("ok"):
+            if first_resp.get("degradations"):
+                degraded += 1
+            if first_resp.get("mrc") != solo[bk]["mrc"]:
+                mismatches += 1
+                print("serve soak: FAIL — the warm first response "
+                      f"diverged (degradations="
+                      f"{first_resp.get('degradations')})")
         for r in outcomes:
             if r.get("ok") and r.get("mrc") != solo[bk]["mrc"]:
                 mismatches += 1
@@ -408,6 +453,25 @@ def serve(n_requests: int, sd: int, chaos: bool,
               f"{degraded} degraded via the ladder, {mismatches} "
               f"divergence(s); batch occupancies seen {sorted(batches)}",
               flush=True)
+
+        # ---- steady-state p50 of the warm entry's shape, closing the
+        # phase-0 SLO: 5 serial requests over hot executables
+        steadies = []
+        with Client(sock) as c0:
+            for _ in range(5):
+                ts = time.perf_counter()
+                c0.request(dict(pool[0], output="both"))
+                steadies.append((time.perf_counter() - ts) * 1e3)
+        steady_p50 = sorted(steadies)[len(steadies) // 2]
+        print(f"serve soak: warm first request {first_ms:.1f} ms vs "
+              f"steady p50 {steady_p50:.1f} ms", flush=True)
+        # floor the denominator: at trivial request cost the 2x bound
+        # would be asserting on scheduler noise, not on compile work
+        if not chaos and first_ms > 2.0 * max(steady_p50, 50.0):
+            print(f"serve soak: FAIL — warmed daemon's first request "
+                  f"({first_ms:.1f} ms) exceeded 2x steady p50 "
+                  f"({steady_p50:.1f} ms)")
+            failures += 1
 
         # ---- drain and stop
         with Client(sock) as c:

@@ -1,0 +1,297 @@
+"""Warm-start execution layer (PR 11): persistent AOT executable
+sidecars, the runtime salt, single-flight compilation, and the README
+contract.
+
+The load-bearing claim is BIT-IDENTITY: an executable restored from a
+sidecar, a fresh in-process compile, and the plain JIT path
+(``PLUSS_NO_AOT=1``) must produce byte-equal histograms and MRCs — the
+warm-start layer is allowed to move compile seconds, never results.
+"""
+
+import os
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+from pluss import cri, engine, mrc, obs, plancache, trace
+from pluss.config import SamplerConfig
+from pluss.models import REGISTRY
+
+
+def _arm(tmp_path, monkeypatch):
+    """Opt back into the disk plan cache (conftest disables it) with a
+    fresh dir + telemetry sink, and start from cold in-process memos."""
+    monkeypatch.delenv("PLUSS_NO_PLAN_CACHE", raising=False)
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_DIR", str(tmp_path / "cache"))
+    obs.configure(str(tmp_path / "tel.jsonl"))
+    engine.compiled.cache_clear()
+    if not plancache.aot_supported():
+        pytest.skip("backend cannot serialize executables")
+
+
+def _mrc_of(res, cfg):
+    ri = cri.distribute(res.noshare_list(), res.share_list(),
+                        cfg.thread_num)
+    return mrc.dedup_lines(mrc.aet_mrc(ri, cfg))
+
+
+def _delta(c0, name):
+    return obs.counters().get(name, 0) - c0.get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: restored executables == fresh compile == plain JIT
+
+
+@pytest.mark.parametrize("model,n", [
+    ("gemm", 16),        # template path
+    ("syrk", 12),        # interleave-overlay path
+    ("cholesky", 10),    # quad nest — the dispatch-sliced shape
+])
+def test_aot_restore_bit_identical(tmp_path, monkeypatch, model, n):
+    _arm(tmp_path, monkeypatch)
+    spec, cfg = REGISTRY[model](n), SamplerConfig(thread_num=2,
+                                                  chunk_size=2)
+    ref = engine.run(spec, cfg)          # cold: compiles + writes sidecars
+    assert list((tmp_path / "cache").glob("*.exe")), \
+        "no AOT sidecar was persisted"
+
+    engine.compiled.cache_clear()        # forget every in-process memo
+    c0 = obs.counters()
+    warm = engine.run(spec, cfg)         # must restore, not recompile
+    assert _delta(c0, "engine.plan_cache.aot_hit") >= 1
+    assert _delta(c0, "engine.compiles") == 0
+    assert _delta(c0, "engine.compile_s") == 0
+
+    monkeypatch.setenv("PLUSS_NO_AOT", "1")
+    engine.compiled.cache_clear()
+    jit = engine.run(spec, cfg)          # plain lazy-JIT ground truth
+
+    for got, tag in ((warm, "restored"), (jit, "jit")):
+        assert got.max_iteration_count == ref.max_iteration_count, tag
+        assert got.noshare_list() == ref.noshare_list(), tag
+        assert got.share_list() == ref.share_list(), tag
+        assert _mrc_of(got, cfg) == _mrc_of(ref, cfg), tag
+
+
+def test_trace_replay_aot_restore_bit_identical(tmp_path, monkeypatch):
+    _arm(tmp_path, monkeypatch)
+    # the replay-fn memo may hold executables resolved by EARLIER tests
+    # (cache disabled then): start cold so the first replay saves sidecars
+    trace._replay_fn_cached.cache_clear()
+    refs_path = str(tmp_path / "refs.bin")
+    rng = np.random.default_rng(7)
+    rng.integers(0, 512, 20_000).astype("<u8").tofile(refs_path)
+
+    r1 = trace.replay_file(refs_path, "u64", cls=16)
+    assert list((tmp_path / "cache").glob("*.exe")), \
+        "trace replay kernel persisted no sidecar"
+
+    trace._replay_fn_cached.cache_clear()
+    c0 = obs.counters()
+    r2 = trace.replay_file(refs_path, "u64", cls=16)
+    assert _delta(c0, "engine.plan_cache.aot_hit") >= 1
+    assert _delta(c0, "engine.compiles") == 0
+
+    monkeypatch.setenv("PLUSS_NO_AOT", "1")
+    trace._replay_fn_cached.cache_clear()
+    r3 = trace.replay_file(refs_path, "u64", cls=16)
+
+    for got, tag in ((r2, "restored"), (r3, "jit")):
+        np.testing.assert_array_equal(np.asarray(got.hist),
+                                      np.asarray(r1.hist), err_msg=tag)
+        assert got.histogram() == r1.histogram(), tag
+
+
+# ---------------------------------------------------------------------------
+# the runtime salt: sidecars pin the PJRT runtime, plan pickles do not
+
+
+def test_runtime_salt_invalidates_sidecars_not_plans(tmp_path,
+                                                     monkeypatch):
+    _arm(tmp_path, monkeypatch)
+    spec, cfg = REGISTRY["gemm"](16), SamplerConfig(thread_num=2,
+                                                    chunk_size=2)
+    ref = engine.run(spec, cfg)
+
+    # a "jax upgrade": the runtime salt changes, the plan source does not
+    engine.compiled.cache_clear()
+    with monkeypatch.context() as m:
+        m.setattr(plancache, "runtime_salt",
+                  lambda: "jax=999.0/other/unknown/nbins=1")
+        c0 = obs.counters()
+        bumped = engine.run(spec, cfg)
+        assert _delta(c0, "engine.plan_cache.aot_hit") == 0
+        assert _delta(c0, "engine.compiles") >= 1, \
+            "stale-runtime sidecar was not recompiled"
+        assert _delta(c0, "engine.plan_cache.hit") >= 1, \
+            "plan pickles must keep the cheaper source-only salt"
+    assert bumped.noshare_list() == ref.noshare_list()
+
+    # back on the original runtime the original sidecars still restore
+    engine.compiled.cache_clear()
+    c0 = obs.counters()
+    engine.run(spec, cfg)
+    assert _delta(c0, "engine.plan_cache.aot_hit") >= 1
+    assert _delta(c0, "engine.compiles") == 0
+
+
+def test_stale_payload_salt_is_a_miss_not_a_load(tmp_path, monkeypatch):
+    # belt and braces: the salt is in the slot PATH and the PAYLOAD; a
+    # well-formed sidecar whose payload carries another runtime's salt
+    # (e.g. a hash collision or a copied cache dir) must read as a miss
+    _arm(tmp_path, monkeypatch)
+    engine.run(REGISTRY["gemm"](16),
+               SamplerConfig(thread_num=2, chunk_size=2))
+    side = sorted((tmp_path / "cache").glob("*.exe"))[0]
+    payload = pickle.loads(side.read_bytes())
+    side.write_bytes(pickle.dumps(("stale-runtime-salt",) + payload[1:]))
+    c0 = obs.counters()
+    assert plancache.aot_load(str(side)) is None
+    assert _delta(c0, "engine.plan_cache.aot_miss") == 1
+    assert _delta(c0, "engine.plan_cache.aot_load_fail") == 0
+
+
+# ---------------------------------------------------------------------------
+# sidecar hygiene: quarantine and group eviction, same as plan pickles
+
+
+def test_corrupt_sidecar_quarantined_and_repaired(tmp_path, monkeypatch,
+                                                  capsys):
+    _arm(tmp_path, monkeypatch)
+    spec, cfg = REGISTRY["gemm"](16), SamplerConfig(thread_num=2,
+                                                    chunk_size=2)
+    ref = engine.run(spec, cfg)
+    cache = tmp_path / "cache"
+    victim = sorted(cache.glob("*.exe"))[0]
+    victim.write_bytes(b"\x00not a serialized executable")
+
+    engine.compiled.cache_clear()
+    c0 = obs.counters()
+    again = engine.run(spec, cfg)
+    assert _delta(c0, "engine.plan_cache.corrupt") >= 1
+    assert _delta(c0, "engine.plan_cache.aot_load_fail") >= 1
+    quarantined = list(cache.glob("*.corrupt"))
+    assert quarantined, "bad sidecar bytes were not set aside"
+    # the freed slot is repopulated: the NEXT process start is warm again
+    assert victim.exists(), "recompile did not refill the sidecar slot"
+    assert again.noshare_list() == ref.noshare_list()
+
+
+def test_eviction_unlinks_sidecars_with_their_pickle(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.delenv("PLUSS_NO_PLAN_CACHE", raising=False)
+    cache = tmp_path / "cache"
+    cache.mkdir()
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_DIR", str(cache))
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_MAX", "1")
+    old, new = "a" * 32, "b" * 32
+    for group, mtime in ((old, 1_000_000), (new, 2_000_000)):
+        for name in (f"{group}.pkl", f"{group}.aot-{'0' * 16}.exe",
+                     f"{group}.aot-{'1' * 16}.exe"):
+            p = cache / name
+            p.write_bytes(b"x")
+            os.utime(p, (mtime, mtime))
+    engine._plan_cache_evict()
+    left = sorted(p.name for p in cache.iterdir())
+    assert all(p.startswith(new) for p in left), left
+    assert not any(p.startswith(old) for p in left), \
+        "evicted group left an orphaned artifact"
+    assert len(left) == 3   # the surviving group keeps ALL its members
+
+
+# ---------------------------------------------------------------------------
+# single-flight: N concurrent requests, one compile
+
+
+def _fan_out(reg, key, build, n):
+    results, errors = [None] * n, [None] * n
+
+    def worker(i):
+        try:
+            results[i] = reg.do(key, build)
+        except BaseException as e:  # noqa: BLE001 — collected for asserts
+            errors[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    return threads, results, errors
+
+
+def _await_waiters(c0, n, timeout=10.0):
+    """Block until n callers are parked on the in-flight build (the
+    single-flight wait counter is bumped right before the park)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if _delta(c0, "engine.compile_singleflight_waits") >= n:
+            return
+        time.sleep(0.01)
+    raise AssertionError("waiters never queued on the in-flight build")
+
+
+def test_single_flight_one_build_many_waiters(tmp_path):
+    obs.configure(str(tmp_path / "tel.jsonl"))
+    reg = plancache.CompileRegistry(gauge="engine.compile_inflight")
+    release = threading.Event()
+    builds = []
+
+    def build():
+        builds.append(threading.get_ident())
+        release.wait(10)
+        return object()
+
+    c0 = obs.counters()
+    threads, results, errors = _fan_out(reg, "k", build, 6)
+    _await_waiters(c0, 5)
+    assert reg.inflight() == 1
+    release.set()
+    for t in threads:
+        t.join(10)
+    assert len(builds) == 1, "concurrent callers duplicated the build"
+    assert errors == [None] * 6
+    assert all(r is results[0] for r in results), \
+        "waiters did not share the leader's result"
+    assert reg.inflight() == 0
+    assert obs.gauges().get("engine.compile_inflight") == 0.0
+
+
+def test_single_flight_failure_rejects_all_waiters_typed(tmp_path):
+    obs.configure(str(tmp_path / "tel.jsonl"))
+    reg = plancache.CompileRegistry()
+    release = threading.Event()
+
+    def build():
+        release.wait(10)
+        raise RuntimeError("injected compile failure")
+
+    c0 = obs.counters()
+    threads, results, errors = _fan_out(reg, "k", build, 6)
+    _await_waiters(c0, 5)
+    release.set()
+    for t in threads:
+        t.join(10)
+    assert results == [None] * 6
+    assert all(isinstance(e, RuntimeError) for e in errors)
+    assert all(e is errors[0] for e in errors), \
+        "waiters must get the leader's exception object, not a retry"
+    # failures are never cached: the next cold caller builds fresh
+    assert reg.do("k", lambda: "recovered") == "recovered"
+
+
+# ---------------------------------------------------------------------------
+# the README contract
+
+
+def test_readme_documents_warm_start():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    text = open(os.path.join(here, "README.md")).read()
+    for needle in ("Warm start", "PLUSS_XLA_CACHE_DIR", "--xla-cache",
+                   "--warm", "PLUSS_NO_AOT", "aot_hit",
+                   "serve.compile_inflight", "PLUSS_PLAN_CACHE_DIR"):
+        assert needle in text, f"README lost the {needle!r} contract"
