@@ -879,6 +879,77 @@ def bench_import(reps: int = 3) -> None:
     }), flush=True)
 
 
+def bench_predict(check_n: int = 16) -> None:
+    """Static-prediction headlines (round r12 on): wall time of the
+    sampling-free symbolic MRC path (pluss/analysis/ri.py) on the flagship
+    gemm-1024 shape — zero device dispatches, so this is the latency a
+    `pluss predict` / serve-admission consumer pays instead of a sampled
+    engine run — plus the max pointwise |predicted - engine| MRC error
+    across the whole registry at a cross-checkable size (bit-identical
+    histograms make this float-summation-order noise, ~1e-16; anything
+    larger is a derivation bug)."""
+    import numpy as np
+
+    from pluss import cri, engine
+    from pluss import mrc as mrc_mod
+    from pluss.analysis import ri
+    from pluss.config import DEFAULT
+    from pluss.models import REGISTRY, gemm
+
+    spec = gemm(1024)
+    t0 = time.perf_counter()
+    rep = ri.predict(spec, DEFAULT)
+    dt = time.perf_counter() - t0
+    method = rep.prediction.method
+    log(f"bench: static predict gemm1024 ({method}): {dt * 1e3:.0f} ms "
+        f"for {rep.prediction.accesses} accesses, zero device dispatches")
+    print(json.dumps({
+        "metric": "gemm1024_static_predict_ms",
+        "value": round_keep(dt * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "path": f"analysis.ri.predict({method})",
+        "degradations": [],
+        "spec_source": "registry",
+        "derivable": rep.prediction.derivable,
+        "plateau_in_bracket": rep.plateau_in_bracket,
+    }), flush=True)
+
+    max_err, worst, n_checked = 0.0, None, 0
+    for name in sorted(REGISTRY):
+        s = REGISTRY[name](check_n)
+        r = ri.predict(s, DEFAULT)
+        if not r.prediction.derivable:
+            continue
+        res = engine.run(s, DEFAULT)
+        theirs = mrc_mod.aet_mrc(
+            cri.distribute(res.noshare_list(), res.share_list(),
+                           DEFAULT.thread_num), DEFAULT)
+        m = min(len(r.curve), len(theirs))
+        err = float(np.max(np.abs(np.asarray(r.curve[:m])
+                                  - np.asarray(theirs[:m]))))
+        n_checked += 1
+        if err > max_err:
+            max_err, worst = err, name
+    log(f"bench: predict max abs MRC error vs engine over {n_checked} "
+        f"families at n={check_n}: {max_err:.2e}"
+        + (f" ({worst})" if worst else ""))
+    print(json.dumps({
+        "metric": "predict_max_abs_err",
+        # UNROUNDED magnitudes survive (the r5 round_keep lesson): a
+        # bit-identical histogram gives ~1e-16 summation-order noise here
+        "value": round_keep(max_err, 9),
+        "unit": "abs_mrc_error",
+        "vs_baseline": None,
+        "path": "analysis.ri.predict vs engine.run",
+        "degradations": [],
+        "spec_source": "registry",
+        "families_checked": n_checked,
+        "n": check_n,
+        "worst_family": worst,
+    }), flush=True)
+
+
 def main() -> int:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
     # persistent XLA compilation cache: the flagship compiles cost minutes
@@ -942,6 +1013,11 @@ def main() -> int:
             bench_import()
         except Exception as e:
             log(f"bench: import metric failed: {e}")
+        if budget_ok("predict", 120):
+            try:
+                bench_predict()
+            except Exception as e:
+                log(f"bench: predict metric failed: {e}")
         if budget_ok("warmstart", 180):
             try:
                 bench_warmstart(128, cpu=True)
@@ -1094,6 +1170,14 @@ def main() -> int:
             bench_import()
         except Exception as e:
             log(f"bench: import metric failed: {e}")
+
+    # static-prediction headlines (round r12 on): host-only symbolic MRC
+    # latency on the flagship shape + registry-wide max error vs the engine
+    if budget_ok("predict", 120):
+        try:
+            bench_predict()
+        except Exception as e:
+            log(f"bench: predict metric failed: {e}")
 
     # accuracy half of the north star (BASELINE.json: "miss-ratio-curve L2
     # error vs C++ baseline" within 1%): MRC of the TPU pipeline vs the

@@ -27,6 +27,11 @@ Code families mirror the analyzer's four passes:
   out-of-grammar steps, missing pragmas, malformed source) — emitted
   BEFORE a spec exists, so they carry source locations instead of tree
   paths.  PL609 wraps an analyzer rejection of a frontend-derived spec.
+- ``PL7xx`` prediction (:mod:`pluss.analysis.ri`): the sampling-free
+  symbolic reuse-interval predictor — typed "not statically derivable"
+  refusals (PL701), enumeration-budget refusals (PL702), derivation-method
+  notes (PL703), and the prover soundness alarm (PL704: exact plateau
+  outside the heuristic MrcBracket — a bug in exactly one of the two).
 
 Severity semantics: ERROR means the spec is wrong (out-of-bounds access,
 undeclared array, contract violation) — ``pluss lint`` exits nonzero.
@@ -105,6 +110,17 @@ CODES: dict[str, tuple[str, str]] = {
                           "duplicate array, out-of-scope index)"),
     "PL609": ("frontend", "frontend-derived spec rejected by the static "
                           "analyzer"),
+    "PL701": ("prediction", "reuse distribution not statically derivable "
+                            "(spec outside the position contract or the "
+                            "address model is invalid)"),
+    "PL702": ("prediction", "exact derivation exceeds the enumeration "
+                            "budget and no closed form applies "
+                            "(PLUSS_PREDICT_BUDGET)"),
+    "PL703": ("prediction", "derivation method note: closed-form periodic "
+                            "or dense polynomial counting"),
+    "PL704": ("prediction", "exact MRC plateau lies outside the static "
+                            "footprint bracket — prover soundness "
+                            "violation"),
 }
 
 

@@ -95,6 +95,14 @@ class MrcBracket:
     c_hi: int             # plateau must be reached by this cache size
     guaranteed_reuse: int  # the closed-form reuse time behind c_lo (0=none)
 
+    def refined(self, c_exact: int) -> "MrcBracket":
+        """Collapse the heuristic bounds onto an exact plateau location
+        proven by the symbolic reuse-interval pass (:mod:`pluss.analysis
+        .ri`) — the floor and the guaranteed-reuse witness are already
+        exact and carry over unchanged."""
+        return MrcBracket(self.floor, c_exact, c_exact,
+                          self.guaranteed_reuse)
+
 
 def _grid_levels(form) -> list[int]:
     """Inner levels that must be enumerated: nonzero-coefficient levels

@@ -135,6 +135,7 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
         targets = [(args.model, REGISTRY[args.model](args.n))]
     all_diags = []
     footprints: dict[str, dict] = {}
+    predictions: dict[str, dict] = {}
     errors = 0
     for name, spec in targets:
         if cfg is None:
@@ -143,9 +144,22 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
             diags, fp = analysis.analyze_spec(spec, cfg)
             footprints[spec.name] = _footprint_doc(
                 fp, analysis.footprint.mrc_bracket(spec, cfg, fp))
+            # the symbolic reuse-interval verdict rides the analyze
+            # report: derivability, method, and the exact plateau next to
+            # the heuristic bracket above (PL704 = soundness alarm)
+            from pluss.analysis import ri
+
+            rep = ri.predict(spec, cfg)
+            predictions[spec.name] = ri.report_doc(rep)
+            diags = diags + rep.prediction.diagnostics
         all_diags += analysis.with_model(diags, spec.name)
         errors += analysis.error_count(diags)
     mode = "lint" if cfg is None else "analyze"
+    if args.sarif:
+        from pluss.analysis import sarif as sarif_mod
+
+        sarif_mod.write_sarif(args.sarif, all_diags)
+        print(f"pluss {mode}: SARIF log at {args.sarif}", file=sys.stderr)
     if args.json:
         doc = json_mod.loads(analysis.format_json(all_diags))
         if cfg is not None:
@@ -153,6 +167,7 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
                                "chunk": cfg.chunk_size,
                                "ds": cfg.ds, "cls": cfg.cls}
             doc["footprint"] = footprints
+            doc["prediction"] = predictions
         out.write(json_mod.dumps(doc, indent=1) + "\n")
     else:
         text = analysis.format_text(all_diags)
@@ -167,11 +182,107 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
                     f"{doc['mrc_floor']:.6g}, plateau in "
                     f"[{doc['mrc_plateau_bounds'][0]}, "
                     f"{doc['mrc_plateau_bounds'][1]}]\n")
+                out.write(_prediction_line(name, predictions[name]))
         n_warn = sum(1 for d in all_diags
                      if d.severity is analysis.Severity.WARNING)
         out.write(f"pluss {mode}: {len(targets)} model(s), {errors} "
                   f"error(s), {n_warn} warning(s)\n")
     return 1 if errors else 0
+
+
+def _prediction_line(name: str, doc: dict) -> str:
+    """One text-report line per model from a ``ri.report_doc`` dict."""
+    if not doc["derivable"]:
+        codes = ",".join(sorted({d["code"]
+                                 for d in doc.get("diagnostics", ())}))
+        return f"{name}: prediction not derivable ({codes})\n"
+    where = "unreachable"
+    if "mrc_plateau_exact" in doc:
+        where = (f"{doc['mrc_plateau_exact']} "
+                 + ("inside" if doc["plateau_in_bracket"] else "OUTSIDE")
+                 + " the bracket")
+    g = f", G={doc['period_horizon']}" if "period_horizon" in doc else ""
+    return (f"{name}: prediction {doc['method']}{g}, {doc['accesses']} "
+            f"accesses, exact plateau {where}\n")
+
+
+def _predict_main(args, p, out, setup_platform) -> int:
+    """``pluss predict <model|--all> [--json|--check|--sarif]`` — the
+    sampling-free static MRC path (:mod:`pluss.analysis.ri`): symbolic
+    per-thread reuse-interval histograms composed through CRI + AET with
+    ZERO device dispatches.  ``--check`` additionally runs the engine on
+    every derivable target and requires bit-identical histograms (MRC
+    within ``ri.MRC_EPS``) — the cross-validation gate run.sh pins."""
+    import json as json_mod
+
+    from pluss import analysis
+    from pluss.analysis import ri
+
+    if args.target is not None and args.all:
+        p.error("predict mode: give a model or --all, not both")
+    if args.target is not None:
+        if args.target not in REGISTRY:
+            p.error(f"predict mode: unknown model {args.target!r}")
+        args.model = args.target
+    cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk)
+    if args.all:
+        targets = [(nm, REGISTRY[nm](args.n)) for nm in sorted(REGISTRY)]
+    else:
+        targets = [(args.model, REGISTRY[args.model](args.n))]
+    docs: dict[str, dict] = {}
+    reports = []
+    all_diags = []
+    errors = 0
+    for name, spec in targets:
+        rep = ri.predict(spec, cfg)
+        reports.append((name, spec, rep))
+        docs[spec.name] = ri.report_doc(rep)
+        all_diags += analysis.with_model(rep.prediction.diagnostics,
+                                         spec.name)
+        errors += analysis.error_count(rep.prediction.diagnostics)
+    rc = 1 if errors else 0
+    if args.check:
+        # cross-validate every derivable prediction against a real
+        # engine run (the only device work in this mode, and only here)
+        setup_platform()
+        for name, spec, rep in reports:
+            if not rep.prediction.derivable:
+                print(f"pluss predict: {spec.name}: check skipped "
+                      "(not derivable)", file=sys.stderr)
+                continue
+            res = engine.run(spec, cfg, SHARE_CAP)
+            ok, detail = ri.check_against_engine(rep, res, cfg)
+            docs[spec.name]["check"] = detail
+            if not ok:
+                rc = 1
+                print(f"pluss predict: {spec.name}: CHECK FAILED "
+                      f"{detail}", file=sys.stderr)
+            else:
+                kind = "bit-identical" if detail["mrc_exact"] \
+                    else f"l2={detail['mrc_l2_error']:.2e}"
+                print(f"pluss predict: {spec.name}: histograms "
+                      f"bit-identical to engine.run, MRC {kind}",
+                      file=sys.stderr)
+    if args.sarif:
+        from pluss.analysis import sarif as sarif_mod
+
+        sarif_mod.write_sarif(args.sarif, all_diags)
+        print(f"pluss predict: SARIF log at {args.sarif}",
+              file=sys.stderr)
+    if args.json:
+        doc = {"schedule": {"threads": cfg.thread_num,
+                            "chunk": cfg.chunk_size,
+                            "ds": cfg.ds, "cls": cfg.cls},
+               "models": docs}
+        out.write(json_mod.dumps(doc, indent=1) + "\n")
+    else:
+        for name, spec, rep in reports:
+            out.write(_prediction_line(spec.name, docs[spec.name]))
+        n_derived = sum(1 for _, _, r in reports
+                        if r.prediction.derivable)
+        out.write(f"pluss predict: {n_derived}/{len(reports)} model(s) "
+                  f"derivable, {errors} error(s)\n")
+    return rc
 
 
 def _verify_spec(spec, cfg: SamplerConfig, out_err) -> int:
@@ -276,6 +387,19 @@ def _import_main(args, p, out, setup_platform) -> int:
                   f"(PLUSS_SPEC_DIR={args.registry_dir} serves it as a "
                   "registry model)", file=sys.stderr)
     rc = 0
+    if args.predict:
+        # frontend-derived specs ride the same static-prediction path as
+        # registry models: host-only, zero device dispatches
+        from pluss.analysis import ri
+
+        cfg = SamplerConfig(thread_num=args.threads,
+                            chunk_size=args.chunk)
+        for spec, _ in pairs:
+            rep = ri.predict(spec, cfg)
+            doc = ri.report_doc(rep)
+            out.write(_prediction_line(spec.name, doc))
+            rc |= 1 if analysis.error_count(
+                rep.prediction.diagnostics) else 0
     if args.run or args.check_model:
         setup_platform()
         run_cfg = SamplerConfig(thread_num=args.threads,
@@ -352,12 +476,13 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="pluss", description=__doc__)
     p.add_argument("mode",
                    choices=("acc", "speed", "mrc", "trace", "sweep",
-                            "sample", "lint", "analyze", "stats",
-                            "serve", "import", "spec"))
+                            "sample", "lint", "analyze", "predict",
+                            "stats", "serve", "import", "spec"))
     p.add_argument("target", nargs="?", default=None,
                    help="stats mode: telemetry event stream (events.jsonl) "
                         "to aggregate; import mode: the .py (DSL) or .c "
-                        "(pragma-C) source file; spec mode: dump | load")
+                        "(pragma-C) source file; spec mode: dump | load; "
+                        "predict mode: the model to predict")
     p.add_argument("arg2", nargs="?", default=None,
                    help="spec mode: the model to dump / the spec JSON "
                         "file to load")
@@ -375,7 +500,12 @@ def main(argv: list[str] | None = None) -> int:
                         "family (at each builder's default size) instead "
                         "of --model/--n")
     p.add_argument("--json", action="store_true",
-                   help="lint/analyze mode: machine-readable diagnostics")
+                   help="lint/analyze/predict mode: machine-readable "
+                        "output")
+    p.add_argument("--sarif", metavar="PATH", default=None,
+                   help="lint/analyze/predict mode: additionally export "
+                        "the PLxxx findings as a SARIF 2.1.0 log at PATH "
+                        "(CI code-scanning annotations)")
     p.add_argument("--verify", action="store_true",
                    help="run the schedule-aware static analyzer before "
                         "the engine modes; ERROR diagnostics abort the "
@@ -494,6 +624,10 @@ def main(argv: list[str] | None = None) -> int:
                         "--n) and require histogram + MRC byte-identical "
                         "to the imported spec's run — the frontend "
                         "bit-identity gate (exit 1 on divergence)")
+    p.add_argument("--predict", action="store_true",
+                   help="import mode: run the sampling-free static MRC "
+                        "predictor (pluss/analysis/ri.py) on each "
+                        "imported spec — no device work")
     p.add_argument("--register", action="store_true",
                    help="import mode: write each derived spec as codec "
                         "JSON into --registry-dir; set PLUSS_SPEC_DIR to "
@@ -525,15 +659,15 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
 
     if args.target is not None and args.mode not in ("stats", "import",
-                                                     "spec"):
+                                                     "spec", "predict"):
         # the optional positionals exist only for `stats <events.jsonl>`,
-        # `import <file>`, and `spec <dump|load> <what>`; anywhere else a
-        # stray argument must stay the usage error it always was
-        # (`pluss lint gemm` would otherwise silently lint the DEFAULT
-        # model and report it clean)
+        # `import <file>`, `spec <dump|load> <what>`, and
+        # `predict <model>`; anywhere else a stray argument must stay the
+        # usage error it always was (`pluss lint gemm` would otherwise
+        # silently lint the DEFAULT model and report it clean)
         p.error(f"unexpected argument {args.target!r} for mode "
                 f"{args.mode!r} (positional input is for stats/import/"
-                "spec modes only; use --model/--file)")
+                "spec/predict modes only; use --model/--file)")
     if args.arg2 is not None and args.mode != "spec":
         p.error(f"unexpected argument {args.arg2!r} for mode "
                 f"{args.mode!r}")
@@ -594,6 +728,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.mode == "spec":
         # shared-codec verbs: `spec dump <model>` / `spec load <file.json>`
         return _spec_main(args, p, sys.stdout, setup_platform)
+
+    if args.mode == "predict":
+        # sampling-free static MRC: the whole path is host arithmetic, so
+        # no platform setup — --check alone boots a device for the
+        # engine cross-run
+        return _predict_main(args, p, sys.stdout, setup_platform)
 
     setup_platform()
 
@@ -728,6 +868,11 @@ def main(argv: list[str] | None = None) -> int:
         sched_block = sweep_mod.schedule_analysis(spec, pts)
         if sched_block:
             out.write(sched_block + "\n")
+        # static prediction per schedule point: derivability + exact
+        # plateau vs the heuristic bracket (pluss/analysis/ri.py)
+        pred_block = sweep_mod.prediction_block(spec, pts)
+        if pred_block:
+            out.write(pred_block + "\n")
     else:  # trace: dynamic replay (BASELINE config 5; bypasses CRI like the
         # reference's pluss_access path — see pluss/trace.py)
         if not args.file:

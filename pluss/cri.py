@@ -29,6 +29,7 @@ parameterization as GSL's ``gsl_ran_negative_binomial_pdf(k, p, n)``
 
 from __future__ import annotations
 
+import functools
 import math
 
 import numpy as np
@@ -61,15 +62,26 @@ def merge(hists: list[Histogram]) -> Histogram:
     return out
 
 
+@functools.lru_cache(maxsize=4096)
 def nbd_dilate(thread_cnt: int, n: int) -> tuple[np.ndarray, np.ndarray]:
     """``_pluss_cri_nbd`` (utils.rs:213-236): (system reuse values, pmf).
 
     Returns keys ``n + k`` for k = 0..K where K is the first index at which the
     cumulative pmf exceeds NBD_MASS_CUT (that term included), or the single
     point mass ``T*n`` past the cutoff.
+
+    Memoized: the pmf depends only on ``(T, n)`` and the noshare keys are
+    log2-binned, so a whole predict/sweep session touches a few dozen
+    distinct pairs while recomputing each lgamma block thousands of
+    times.  The cached arrays are frozen — every caller reads or
+    multiplies into fresh output, none writes in place.
     """
     if n >= NBD_CUTOFF_COEF * (thread_cnt - 1) / thread_cnt:
-        return np.array([thread_cnt * n], np.int64), np.array([1.0])
+        keys = np.array([thread_cnt * n], np.int64)
+        pmf = np.array([1.0])
+        keys.setflags(write=False)
+        pmf.setflags(write=False)
+        return keys, pmf
     p = 1.0 / thread_cnt
     r = float(n)
     # mean of NB(r, p) is r(1-p)/p = (T-1)n; 0.9999 mass sits within a few stds
@@ -84,8 +96,11 @@ def nbd_dilate(thread_cnt: int, n: int) -> tuple[np.ndarray, np.ndarray]:
         over = np.nonzero(cum > NBD_MASS_CUT)[0]
         if over.size:
             stop = int(over[0]) + 1  # include the crossing term
-            ks_i = np.arange(stop, dtype=np.int64)
-            return ks_i + n, pmf[:stop]
+            keys = np.arange(stop, dtype=np.int64) + n
+            pmf = pmf[:stop]
+            keys.setflags(write=False)
+            pmf.setflags(write=False)
+            return keys, pmf
         ks = np.arange(0, ks.size * 2, dtype=np.float64)  # pragma: no cover
 
 

@@ -2090,6 +2090,12 @@ def _auto_dispatch(pl: StreamPlan, cfg: SamplerConfig,
     return _normalize_thread_batch(conc, cfg), "; ".join(reasons)
 
 
+#: monotonic count of device dispatches this process has issued through
+#: :func:`run` — the witness the zero-dispatch contract of
+#: ``pluss predict`` (:mod:`pluss.analysis.ri`) is asserted against
+DEVICE_DISPATCHES = 0
+
+
 def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
         share_cap: int = SHARE_CAP, assignment=None, start_point=None,
         window_accesses=None, backend: str = "vmap",
@@ -2135,6 +2141,8 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
                      window_accesses, backend,
                      _normalize_thread_batch(thread_batch, cfg))
     tids = jnp.arange(cfg.thread_num, dtype=jnp.int32)
+    global DEVICE_DISPATCHES
+    DEVICE_DISPATCHES += 1
     with obs.span("engine.dispatch", model=spec.name, backend=backend), \
             xprof.session(), xprof.annotate(f"pluss.engine.{spec.name}"):
         packed = np.asarray(f(tids))

@@ -62,10 +62,49 @@ MAX_REFS_DEFAULT = 1 << 34
 _anon_ids = itertools.count(1)
 
 
+#: default per-request STATIC-COST bound: predicted refs plus the
+#: line-weighted footprint, both from the static analyzer — a spec is
+#: priced on what it will actually make the device loop do, not just its
+#: raw stream length.  Wide enough for gemm-1024 (cost ~4.3e9)
+MAX_COST_DEFAULT = 1 << 35
+
+#: default weight of one footprint line in the cost formula (a distinct
+#: line costs a last-access-table slot and sort bandwidth per window)
+LINE_COST_DEFAULT = 64
+
+#: footprint masks allocate O(declared lines) booleans; refuse to even
+#: price a spec whose declared arrays exceed this (hostile-spec guard)
+_COST_LINES_CAP = 1 << 28
+
+
 def max_serve_refs() -> int:
     from pluss.utils.envknob import env_int
 
     return env_int("PLUSS_SERVE_MAX_REFS", MAX_REFS_DEFAULT)
+
+
+def max_serve_cost() -> int:
+    from pluss.utils.envknob import env_int
+
+    return env_int("PLUSS_SERVE_MAX_COST", MAX_COST_DEFAULT)
+
+
+def serve_line_cost() -> int:
+    from pluss.utils.envknob import env_int
+
+    return env_int("PLUSS_SERVE_LINE_COST", LINE_COST_DEFAULT, minimum=0)
+
+
+@functools.lru_cache(maxsize=256)
+def _static_cost(spec: LoopNestSpec, cfg: SamplerConfig) -> tuple[int, int]:
+    """Memoized (predicted refs, touched footprint lines) of one spec
+    under one schedule — the static analyzer's exact counts
+    (:func:`pluss.analysis.footprint.footprints`), shared across requests
+    like the lint verdict."""
+    from pluss.analysis import footprint
+
+    fp = footprint.footprints(spec, cfg)
+    return int(fp.accesses), int(fp.total)
 
 
 # ---------------------------------------------------------------------------
@@ -345,6 +384,26 @@ def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
             f"request {rid!r}: spec {spec.name!r} rejected by the static "
             f"analyzer ({len(errs)} ERROR diagnostic(s))",
             site="serve.admission", diagnostics=errs)
+    # STATIC-COST pricing (after the lint gate: only well-formed specs
+    # are worth pricing): predicted refs + line-weighted footprint from
+    # the analyzer's exact counts, so a short-stream/huge-footprint spec
+    # can't slip under the raw PLUSS_SERVE_MAX_REFS stream bound
+    cost_bound = max_serve_cost()
+    line_w = serve_line_cost()
+    declared = sum(spec.line_counts(cfg))
+    if declared > _COST_LINES_CAP:
+        raise InvalidRequest(
+            f"request {rid!r}: declared arrays span {declared} cache "
+            f"lines — beyond what admission will even price "
+            f"(PLUSS_SERVE_MAX_COST)", site="serve.admission")
+    refs, fp_lines = _static_cost(spec, cfg)
+    cost = refs + line_w * fp_lines
+    if cost > cost_bound:
+        raise InvalidRequest(
+            f"request {rid!r}: static cost {cost} (predicted {refs} refs "
+            f"+ {line_w}x{fp_lines} footprint lines) exceeds the "
+            f"per-request bound {cost_bound} (PLUSS_SERVE_MAX_COST)",
+            site="serve.admission")
     req.spec = spec
     return req
 

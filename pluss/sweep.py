@@ -347,6 +347,37 @@ def schedule_analysis(spec: LoopNestSpec,
     return "schedule-aware analysis:\n" + "\n".join(lines)
 
 
+def prediction_block(spec: LoopNestSpec,
+                     points: Iterable[SweepPoint]) -> str:
+    """Static-prediction block for the sweep report: per swept config,
+    the symbolic reuse-interval derivation's verdict (:mod:`pluss.
+    analysis.ri`) — method taken, exact plateau location, and whether it
+    lands inside the PR-3 heuristic bracket.  The sampled table above and
+    this block predict the same quantity from independent machinery, so
+    reading them together IS the cross-check."""
+    from pluss.analysis import ri
+
+    points = list(points)
+    if not points:
+        return ""
+    lines = []
+    for p in points:
+        rep = ri.predict(spec, p.cfg)
+        pred = rep.prediction
+        head = (f"  threads={p.cfg.thread_num} "
+                f"chunk={p.cfg.chunk_size}: ")
+        if not pred.derivable:
+            codes = ",".join(sorted({d.code for d in pred.diagnostics}))
+            lines.append(head + f"not derivable ({codes})")
+            continue
+        where = "unreachable" if rep.plateau is None else (
+            f"{rep.plateau} "
+            + ("inside" if rep.plateau_in_bracket else "OUTSIDE")
+            + f" [{rep.bracket.c_lo}, {rep.bracket.c_hi}]")
+        lines.append(head + f"{pred.method}, exact plateau {where}")
+    return "static prediction (PL7xx):\n" + "\n".join(lines)
+
+
 def carried_levels(spec: LoopNestSpec) -> str:
     """The static analyzer's PL303 carried-level classifications as a
     compact report block (ROADMAP PR-1 follow-up): one line per annotated

@@ -21,6 +21,22 @@ python -m pluss.cli lint --all 1>&2
 # still pure host analysis, ~20 s for the registry at default sizes.
 python -m pluss.cli analyze --all 1>&2
 
+# static prediction gate (tier-1, r12): the sampling-free symbolic
+# reuse-interval predictor (pluss/analysis/ri.py) over the whole registry
+# at n=16, --check cross-running the engine on every derivable model and
+# requiring bit-identical histograms (MRC within ri.MRC_EPS) plus the
+# exact plateau inside the heuristic MrcBracket.  The SARIF export is
+# smoke-parsed through the structural validator — a malformed log breaks
+# CI consumers silently, so it gates here.
+PLUSS_PREDICT_SARIF=$(mktemp /tmp/pluss_predict_XXXX.sarif)
+JAX_PLATFORMS=cpu python -m pluss.cli predict --all --n 16 --check --cpu \
+  --sarif "$PLUSS_PREDICT_SARIF" 1>&2
+python -c "import json, sys; from pluss.analysis import sarif; \
+doc = json.load(open(sys.argv[1])); errs = sarif.validate(doc); \
+assert not errs, errs; print('predict SARIF smoke: valid,', \
+    len(doc['runs'][0]['results']), 'result(s)')" "$PLUSS_PREDICT_SARIF" 1>&2
+rm -f "$PLUSS_PREDICT_SARIF"
+
 # frontend import smoke (tier-1): the checked-in gemm.ppcg_omp-shaped C
 # source → tokenizer → recursive-descent parse → lower → share-span
 # derivation → PR-1 analyzer gate → engine run, with --check-model

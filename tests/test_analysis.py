@@ -231,6 +231,19 @@ def test_readme_code_table_matches_registry():
     assert documented == set(CODES), (
         "README diagnostic-code table out of sync with "
         "pluss.analysis.diagnostics.CODES")
+    # every registered code must have an actual TABLE ROW with a valid
+    # severity word — a prose mention alone doesn't document a code
+    rows = dict(re.findall(r"^\| (PL\d{3}) \| (\w+) \|", readme,
+                           flags=re.M))
+    assert set(rows) == set(CODES), (
+        "README is missing a code-table row for: "
+        f"{sorted(set(CODES) - set(rows))}")
+    assert set(rows.values()) <= {"error", "warning", "info"}
+    # the r12 prediction family documents its emitted severities exactly
+    assert rows["PL701"] == "warning"     # refusal, not a broken spec
+    assert rows["PL702"] == "warning"
+    assert rows["PL703"] == "info"
+    assert rows["PL704"] == "error"       # prover soundness violation
 
 
 def test_diagnostic_json_roundtrip():

@@ -1,0 +1,349 @@
+"""predict ≡ engine: the sampling-free symbolic MRC path (round r12).
+
+The contract under test (`pluss/analysis/ri.py` + `pluss/analysis/
+polycount.py`):
+
+- **Exactness**: on every derivable spec the symbolic per-thread
+  histograms are BIT-IDENTICAL to a real `engine.run` — same noshare
+  bins, same share raw keys, same masses, same access count
+  (`Prediction.matches_engine`).  The composed MRC is bit-identical on
+  the closed-form (uniform-reuse) families and within `ri.MRC_EPS`
+  elsewhere (bit-equal histograms can still differ by float summation
+  ORDER inside CRI's dilation — the engine's share_raw dict carries
+  device-merge insertion order, the symbolic one is sorted).
+- **Soundness**: the exact plateau (`mrc.plateau_of`) must lie inside
+  PR-3's heuristic MrcBracket `[c_lo, c_hi]` on every derivable spec —
+  a violation means one of the two independent provers is wrong (PL704).
+- **Zero device dispatches**: the whole predict path is host arithmetic;
+  `engine.DEVICE_DISPATCHES` is the witness.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from pluss import cli, cri, engine, mrc, sweep
+from pluss.analysis import ri, sarif
+from pluss.analysis.diagnostics import CODES, Diagnostic, Severity
+from pluss.config import SamplerConfig
+from pluss.models import REGISTRY
+
+# the fast tier-1 subset: both closed-form rungs (gemm: G=1 rectangular;
+# conv2d: multi-coefficient uniform) and three dense-rung shapes
+# (triangular lu, rectangular-multi-nest atax, self-reuse syrk)
+FAST_FAMILIES = ("gemm", "conv2d", "lu", "syrk", "atax")
+#: families the closed-form periodic rung must take at the default config
+CLOSED_FORM = {"gemm", "conv2d"}
+
+
+def _engine_curve(res, cfg):
+    return mrc.aet_mrc(cri.distribute(res.noshare_list(), res.share_list(),
+                                      cfg.thread_num), cfg)
+
+
+# ---------------------------------------------------------------------------
+# predict ≡ engine (fast tier-1 subset)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", FAST_FAMILIES)
+def test_fast_predict_matches_engine(name):
+    spec = REGISTRY[name](16)
+    cfg = SamplerConfig(thread_num=4, chunk_size=4)
+    rep = ri.predict(spec, cfg)
+    assert rep.prediction.derivable, rep.prediction.diagnostics
+    if name in CLOSED_FORM:
+        assert rep.prediction.method == "closed-form"
+    res = engine.run(spec, cfg)
+    # histograms bit-identical — bins, raw share keys, masses, accesses
+    assert rep.prediction.matches_engine(res)
+    theirs = _engine_curve(res, cfg)
+    assert len(rep.curve) == len(theirs)
+    if name in CLOSED_FORM:
+        # uniform families: the composed curve is bit-identical too
+        assert np.array_equal(np.asarray(rep.curve), np.asarray(theirs))
+    err = float(np.max(np.abs(np.asarray(rep.curve) - np.asarray(theirs))))
+    assert err <= ri.MRC_EPS
+    ok, detail = ri.check_against_engine(rep, res, cfg)
+    assert ok, detail
+    assert detail["histogram_identical"] and detail["plateau_in_bracket"]
+
+
+def test_predict_matches_engine_across_threads():
+    # the thread axis is where the closed-form period shift lives: the
+    # same family must stay bit-exact at T=1 (no sharing at all) and T=2
+    spec_builder = REGISTRY["gemm"]
+    for T in (1, 2):
+        cfg = SamplerConfig(thread_num=T, chunk_size=4)
+        rep = ri.predict(spec_builder(16), cfg)
+        res = engine.run(spec_builder(16), cfg)
+        assert rep.prediction.matches_engine(res), T
+
+
+# ---------------------------------------------------------------------------
+# plateau ⊆ bracket: the r12 soundness regression (all 29 × T ∈ {1,2,4})
+# ---------------------------------------------------------------------------
+
+def test_exact_plateau_inside_bracket_all_families():
+    """Predict-only (no engine): every registry family at every swept
+    thread count must derive, reach its plateau, and land the exact
+    plateau inside the PR-3 heuristic bracket — PL704 must never fire on
+    the registry."""
+    for name in sorted(REGISTRY):
+        for T in (1, 2, 4):
+            cfg = SamplerConfig(thread_num=T, chunk_size=4)
+            rep = ri.predict(REGISTRY[name](16), cfg)
+            assert rep.prediction.derivable, (name, T)
+            assert rep.plateau is not None, (name, T)
+            assert rep.plateau_in_bracket, (name, T)
+            assert rep.bracket.c_lo <= rep.plateau <= rep.bracket.c_hi, \
+                (name, T, rep.plateau, rep.bracket)
+            assert not any(d.code == "PL704"
+                           for d in rep.prediction.diagnostics)
+            # the refined bracket collapses to the proven point
+            refined = rep.refined_bracket
+            assert refined.c_lo == refined.c_hi == rep.plateau
+
+
+def test_refusals_are_typed_not_silent():
+    # a spec outside the position contract must come back as a typed
+    # PL701 refusal, never an exception or a silently-wrong histogram
+    from pluss.spec import Loop, LoopNestSpec, Ref
+
+    bad = LoopNestSpec("oob", (("A", 1),), (
+        Loop(trip=8, bound_coef=(1, 1),
+             body=(Ref("A0", "A", addr_terms=((0, 1),)),)),))
+    pred = ri.derive(bad)
+    assert not pred.derivable
+    assert any(d.code == "PL701" for d in pred.diagnostics)
+
+    # a derivable spec under a starvation budget refuses with PL702
+    pred = ri.derive(REGISTRY["lu"](16), budget=16)
+    assert not pred.derivable
+    assert any(d.code == "PL702" for d in pred.diagnostics)
+
+
+# ---------------------------------------------------------------------------
+# zero device dispatches
+# ---------------------------------------------------------------------------
+
+def test_predict_makes_zero_device_dispatches(monkeypatch):
+    # the witness counter must not move across both derivation rungs, and
+    # the engine entry point must be unreachable from the predict path
+    monkeypatch.setattr(engine, "run",
+                        lambda *a, **k: pytest.fail(
+                            "predict path called engine.run"))
+    before = engine.DEVICE_DISPATCHES
+    for name in ("gemm", "lu"):        # closed-form rung + dense rung
+        rep = ri.predict(REGISTRY[name](16), SamplerConfig(thread_num=4))
+        assert rep.prediction.derivable
+    assert engine.DEVICE_DISPATCHES == before
+
+
+# ---------------------------------------------------------------------------
+# SARIF export (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_sarif_roundtrip_schema():
+    cfg = SamplerConfig(thread_num=4)
+    diags = []
+    for name in ("gemm", "lu"):
+        rep = ri.predict(REGISTRY[name](16), cfg)
+        diags += rep.prediction.diagnostics
+    doc = sarif.to_sarif(diags)
+    # JSON round-trip: the export is plain data, losslessly serializable
+    doc2 = json.loads(json.dumps(doc))
+    assert doc2 == doc
+    assert sarif.validate(doc2) == []
+    run = doc2["runs"][0]
+    assert doc2["version"] == "2.1.0"
+    assert run["tool"]["driver"]["name"] == "pluss"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    for r in run["results"]:
+        assert r["ruleId"] in rule_ids
+        assert r["ruleId"] in CODES
+        assert r["level"] in ("error", "warning", "note")
+        assert r["message"]["text"]
+
+
+def test_sarif_level_mapping_and_validate_rejects():
+    d_err = Diagnostic("PL704", Severity.ERROR, "x", model="m")
+    d_warn = Diagnostic("PL701", Severity.WARNING, "x", model="m")
+    d_info = Diagnostic("PL703", Severity.INFO, "x", model="m")
+    doc = sarif.to_sarif([d_err, d_warn, d_info])
+    levels = [r["level"] for r in doc["runs"][0]["results"]]
+    assert levels == ["error", "warning", "note"]
+    # the structural validator actually rejects malformed documents
+    assert sarif.validate({"version": "2.1.0", "runs": []})
+    broken = json.loads(json.dumps(doc))
+    broken["runs"][0]["results"][0]["ruleId"] = "PL999"
+    assert sarif.validate(broken)
+
+
+def test_sarif_write_and_cli_export(tmp_path, capsys):
+    out = tmp_path / "predict.sarif"
+    assert cli.main(["predict", "gemm", "--n", "16",
+                     "--sarif", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert sarif.validate(doc) == []
+    assert any(r["ruleId"] == "PL703"
+               for r in doc["runs"][0]["results"])
+    # lint rides the same flag
+    out2 = tmp_path / "lint.sarif"
+    assert cli.main(["lint", "--model", "durbin", "--n", "16",
+                     "--sarif", str(out2)]) == 0
+    assert sarif.validate(json.loads(out2.read_text())) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_predict_text(capsys):
+    assert cli.main(["predict", "gemm", "--n", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "closed-form" in out
+    assert "inside the bracket" in out
+    assert "1/1 model(s) derivable" in out
+
+
+def test_cli_predict_json(capsys):
+    assert cli.main(["predict", "lu", "--n", "16", "--json",
+                     "--threads", "2"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schedule"]["threads"] == 2
+    m = doc["models"]["lu16"]
+    assert m["derivable"] and m["method"] == "dense"
+    assert m["plateau_in_bracket"] is True
+    assert any(d["code"] == "PL703" for d in m["diagnostics"])
+
+
+def test_cli_predict_check(capsys):
+    # the run.sh gate shape, one model: engine cross-run must agree
+    assert cli.main(["predict", "gemm", "--n", "16", "--check",
+                     "--cpu"]) == 0
+    err = capsys.readouterr().err
+    assert "bit-identical" in err
+
+
+def test_cli_predict_rejects_unknown_model():
+    with pytest.raises(SystemExit):
+        cli.main(["predict", "nosuchmodel"])
+    with pytest.raises(SystemExit):
+        cli.main(["predict", "gemm", "--all"])
+
+
+def test_cli_analyze_carries_prediction_block(capsys):
+    assert cli.main(["analyze", "--model", "gemm", "--n", "16",
+                     "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    pred = doc["prediction"]["gemm16"]
+    assert pred["derivable"] and pred["plateau_in_bracket"]
+    # the exact plateau must sit inside the heuristic bounds reported by
+    # the SAME document's footprint block (the cross-prover check, as a
+    # consumer would apply it)
+    lo, hi = doc["footprint"]["gemm16"]["mrc_plateau_bounds"]
+    assert lo <= pred["mrc_plateau_exact"] <= hi
+
+
+def test_cli_import_predict(capsys):
+    # frontend-derived specs ride the same static path, still no device
+    assert cli.main(["import",
+                     "pluss/frontend/examples/gemm.ppcg_omp.c",
+                     "--predict", "--n", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "prediction closed-form" in out
+    assert "inside the bracket" in out
+
+
+def test_sweep_prediction_block():
+    spec = REGISTRY["gemm"](16)
+    pts = [sweep.SweepPoint(cfg=SamplerConfig(thread_num=T, chunk_size=4),
+                            curve=np.zeros(1), total_refs=0)
+           for T in (1, 2)]
+    block = sweep.prediction_block(spec, pts)
+    assert "static prediction (PL7xx):" in block
+    assert "threads=1 chunk=4" in block and "threads=2 chunk=4" in block
+    assert "OUTSIDE" not in block
+
+
+# ---------------------------------------------------------------------------
+# serve admission: static-cost pricing (tentpole wiring)
+# ---------------------------------------------------------------------------
+
+def test_serve_admission_static_cost(monkeypatch):
+    from pluss.resilience.errors import InvalidRequest
+    from pluss.serve.protocol import parse_request
+
+    # generous stream bound, tiny cost bound: the request is now priced
+    # by predicted refs + line_cost x footprint lines, not raw size
+    monkeypatch.setenv("PLUSS_SERVE_MAX_COST", "1000")
+    with pytest.raises(InvalidRequest) as ei:
+        parse_request({"model": "gemm", "n": 16})
+    assert "PLUSS_SERVE_MAX_COST" in str(ei.value)
+    assert "static cost" in str(ei.value)
+    # the line-cost weight is live: zero weight prices footprint out
+    monkeypatch.setenv("PLUSS_SERVE_LINE_COST", "0")
+    monkeypatch.setenv("PLUSS_SERVE_MAX_COST", "20000")
+    parse_request({"model": "gemm", "n": 16})   # 16896 refs + 0*96 fits
+    monkeypatch.setenv("PLUSS_SERVE_LINE_COST", "64")
+    with pytest.raises(InvalidRequest):
+        parse_request({"model": "gemm", "n": 16})  # + 64*96 does not
+    # defaults admit the whole registry at bench sizes
+    monkeypatch.delenv("PLUSS_SERVE_MAX_COST")
+    monkeypatch.delenv("PLUSS_SERVE_LINE_COST")
+    parse_request({"model": "gemm", "n": 16})
+
+
+def test_serve_admission_refs_bound_still_first(monkeypatch):
+    # the r07 PLUSS_SERVE_MAX_REFS contract is untouched: a stream-bound
+    # violation still rejects with the original message, before cost
+    from pluss.resilience.errors import InvalidRequest
+    from pluss.serve.protocol import parse_request
+
+    monkeypatch.setenv("PLUSS_SERVE_MAX_REFS", "1000")
+    monkeypatch.setenv("PLUSS_SERVE_MAX_COST", "1")
+    with pytest.raises(InvalidRequest) as ei:
+        parse_request({"model": "gemm", "n": 16})
+    assert "PLUSS_SERVE_MAX_REFS" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# full sweep (slow): every family + the frontend corpus vs the engine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_full_registry_predict_matches_engine():
+    cfg = SamplerConfig(thread_num=4, chunk_size=4)
+    for name in sorted(REGISTRY):
+        spec = REGISTRY[name](16)
+        rep = ri.predict(spec, cfg)
+        assert rep.prediction.derivable, name
+        res = engine.run(spec, cfg)
+        assert rep.prediction.matches_engine(res), name
+        ok, detail = ri.check_against_engine(rep, res, cfg)
+        assert ok, (name, detail)
+
+
+@pytest.mark.slow
+def test_frontend_imported_specs_ride_predict_path():
+    from pluss.frontend import polybench
+
+    cfg = SamplerConfig(thread_num=4, chunk_size=4)
+    derived = 0
+    for name, spec in sorted(polybench.import_polybench().items()):
+        rep = ri.predict(spec, cfg)
+        if not rep.prediction.derivable:
+            # refusal must be typed, never an exception
+            assert any(d.code in ("PL701", "PL702")
+                       for d in rep.prediction.diagnostics), name
+            continue
+        derived += 1
+        assert rep.plateau_in_bracket, name
+        res = engine.run(spec, cfg)
+        assert rep.prediction.matches_engine(res), name
+        ok, detail = ri.check_against_engine(rep, res, cfg)
+        assert ok, (name, detail)
+    assert derived, "no polybench source derived — the path is dead"
