@@ -43,6 +43,9 @@ import time
 PROBE_TIMEOUT_S = 120
 REPS = 3
 _T_START = time.monotonic()
+# resident replay rate per trace size (refs/s), stashed by
+# bench_trace_resident for the streamed line's streamed_vs_resident_ratio
+_RESIDENT_RATE: dict[int, float] = {}
 # default wall budget: slightly under the 20-minute mark so that if the
 # driver wraps the bench in its own ~1200 s timeout, the graceful SKIP
 # path always wins the race against a hard rc=124 kill
@@ -408,47 +411,30 @@ def native_trace_rate(path: str) -> float | None:
 
 def cached_pack(path: str, n_refs: int) -> tuple[dict | None, bool, str]:
     """(pack sidecar meta, was_cached, packed path) of the staged
-    (packed) trace, persisted across runs and keyed by size +
-    source-trace content + wire-format version — like the
-    native-baseline cache.  The old
-    existence-only check would happily replay a stale pack after the
-    source trace regenerated or the wire format changed; now a key
-    mismatch forces a repack (with a logged reason), and the metric line
-    carries ``staging_cached`` so a round that paid the ~minutes repack
-    is distinguishable from one that reused the staged bytes."""
-    import json as _json
-
+    (packed) trace.  Thin caller of :func:`pluss.trace.pack_cached` —
+    the staleness key (ref count + source-trace content + wire-format
+    version + batch grid) was promoted there in r13 so every consumer
+    shares it — keeping the bench's own concerns here: the ``.bench/``
+    naming, the one-time packing budget gate, and the logging behind the
+    ``staging_cached`` stamp that distinguishes a round that paid the
+    ~minutes repack from one that reused the staged bytes."""
     from pluss import trace
 
     packed = f".bench/trace_{n_refs}.pack"
-    sidecar = packed + ".json"
-    if os.path.exists(packed) and os.path.exists(sidecar):
-        try:
-            with open(sidecar) as f:
-                meta = _json.load(f)
-        except ValueError:
-            meta = {}
-        # d24v is the wanted on-disk format (staging ships the compressed
-        # records; i32 is the >2^24-line fallback pack_file may have
-        # chosen) — and a d24v pack is only stageable at its own batch
-        # grid, so a PLUSS_BATCH_WINDOWS change also forces a repack
-        fmt_ok = meta.get("fmt") == "i32" or (
-            meta.get("fmt") == "d24v"
-            and meta.get("batch") == trace.TRACE_WINDOW
-            * trace.WINDOWS_PER_BATCH)
-        if meta.get("n") == n_refs \
-                and meta.get("src_fp") == trace._trace_fingerprint(path) \
-                and meta.get("wire") == trace.WIRE_VERSION and fmt_ok:
-            log(f"bench: staged trace pack {packed}: cached "
-                f"({meta['n_lines']} line slots, fmt {meta['fmt']})")
-            return meta, True, packed
+    meta, was_cached, _ = trace.pack_cached(path, packed, wire="d24v",
+                                            allow_pack=False)
+    if was_cached:
+        log(f"bench: staged trace pack {packed}: cached "
+            f"({meta['n_lines']} line slots, fmt {meta['fmt']})")
+        return meta, True, packed
+    if os.path.exists(packed):
         log("bench: staged trace pack is stale (source trace, wire "
             "format, or batch grid changed); repacking")
     if not budget_ok("trace pack_file (one-time)", 420):
         return None, False, packed
     log(f"bench: packing trace ids (one-time) at {packed}")
     t0 = time.perf_counter()
-    meta = trace.pack_file(path, packed, wire="d24v")
+    meta, _, _ = trace.pack_cached(path, packed, wire="d24v")
     log(f"bench: packed in {time.perf_counter() - t0:.1f}s "
         f"({meta['n_lines']} line slots, fmt {meta['fmt']})")
     return meta, False, packed
@@ -460,6 +446,8 @@ def bench_trace_resident(n_refs: int) -> None:
     separately, so the metric is independent of tunnel h2d weather.  The
     packed-id file is produced once by trace.pack_file and reused across
     rounds via :func:`cached_pack`."""
+    import numpy as np
+
     from pluss import obs, trace
 
     c0 = obs.counters()
@@ -498,6 +486,46 @@ def bench_trace_resident(n_refs: int) -> None:
          upload_s=round(stats["upload_s"], 1),
          upload_mb_s=round(mb / stats["upload_s"], 2),
          **compile_stamp(c0))
+    # the resident rate baselines the r13 metrics below AND the streamed
+    # e2e line's streamed_vs_resident_ratio (bench_trace runs after us)
+    _RESIDENT_RATE[n_refs] = n_run / replay_s
+    # r13 warm-replay headline: publish the staged bytes into the
+    # residency store under replay_file's own key, then time a
+    # replay_file(resident_cache=True) HIT — what a repeat serve request
+    # pays: resident replay with ZERO feed bytes (the h2d delta and hit
+    # count ride the metric line as proof)
+    from pluss import residency
+
+    st = residency.store()
+    key = trace._residency_key(path, cls=64, window=trace.TRACE_WINDOW,
+                               bw=trace._resolve_bw(None),
+                               precompacted=False)
+    try:
+        st.reserve(int(resident.nbytes), site="bench.residency")
+    except Exception as e:
+        log(f"bench: residency store cannot fit the staged trace; "
+            f"skipping the warm headline: {e}")
+        return
+    st.put(key, resident, n_lines=meta["n_lines"], n_run=n_run,
+           nbytes=int(resident.nbytes), meta={"path": path, "bench": True})
+    trace.replay_file(path, limit_refs=n_run, resident_cache=True)  # warm
+    ch0 = obs.counters()
+    t0 = time.perf_counter()
+    rep_w = trace.replay_file(path, limit_refs=n_run, resident_cache=True)
+    warm_s = time.perf_counter() - t0
+    ch1 = obs.counters()
+
+    def cdelta(k):
+        return ch1.get(k, 0.0) - ch0.get(k, 0.0)
+
+    assert int(rep_w.histogram().sum()) == n_run
+    assert bool(np.array_equal(rep_w.histogram(), rep.histogram()))
+    emit(f"trace{n_refs}_warm_replay_refs_per_sec", n_run, warm_s, replay_s,
+         path="trace_residency",
+         refs_replayed=n_run, refs_requested=n_refs,
+         shrunk=bool(n_run != n_refs),
+         residency_hits=int(cdelta("residency.hit")),
+         h2d_bytes_delta=int(cdelta("trace.h2d_bytes")))
 
 
 def bench_trace(n_refs: int) -> None:
@@ -582,6 +610,12 @@ def bench_trace(n_refs: int) -> None:
     obs_extra["wire"] = rep.wire or trace._resolve_wire(None)
     obs_extra["feed_workers"] = (rep.feed_workers
                                  or trace._resolve_feed_workers(None))
+    # streamed-vs-resident gap (r13): how much the residency store's warm
+    # path buys over this very streamed rate (<1 = streamed is slower;
+    # null when the resident metric was skipped this round)
+    res_rate = _RESIDENT_RATE.get(n_refs)
+    obs_extra["streamed_vs_resident_ratio"] = (
+        round_keep((n_run / best_s) / res_rate, 4) if res_rate else None)
     # native replay is linear in refs, so one measured (refs, seconds) pair
     # scales to whatever prefix the feed budget allowed this round
     rate = native_trace_rate(path)
@@ -850,6 +884,64 @@ def bench_serve_warm(n: int = 64) -> None:
     }), flush=True)
 
 
+def bench_serve_trace_warm(n_refs: int = 1 << 22,
+                           n_requests: int = 8) -> None:
+    """Warm-trace serving headline (r13): p50 client-side latency of
+    REPEAT trace requests against an in-process daemon riding the
+    residency store — the first request pays streaming + stage-through
+    population, every repeat replays the HBM entry with zero feed bytes.
+    The cold first latency rides the line as the baseline, so the record
+    shows what residency buys a trace tenant."""
+    import tempfile
+
+    from pluss import obs, trace
+    from pluss.serve import Client, ServeConfig, Server
+
+    path = ensure_trace(n_refs)
+    # size the request window so ONE staging batch covers the trace: at
+    # the default 2^20 window a small trace pads to a 16M-ref batch and
+    # the kernel (identical warm and cold) drowns the residency signal
+    win = max(1 << 14, n_refs // trace.WINDOWS_PER_BATCH)
+    sock = tempfile.mktemp(prefix="pluss_bench_servetrace_", suffix=".sock")
+    srv = Server(socket_path=sock, config=ServeConfig(max_batch=4))
+    srv.start()
+    c0 = obs.counters()
+    cold = None
+    lat: list[float] = []
+    try:
+        with Client(sock) as c:
+            for i in range(n_requests):
+                t0 = time.perf_counter()
+                r = c.request({"trace": path, "window": win,
+                               "id": f"warmtrace-{i}"})
+                dt = (time.perf_counter() - t0) * 1e3
+                if not r.get("ok"):
+                    raise RuntimeError(f"serve trace request failed: {r}")
+                if i == 0:
+                    cold = dt
+                else:
+                    lat.append(dt)
+    finally:
+        srv.shutdown()
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    hits = int(obs.counters().get("residency.hit", 0)
+               - c0.get("residency.hit", 0))
+    log(f"bench: serve trace cold {cold:.1f} ms, warm p50 {p50:.1f} ms "
+        f"over {len(lat)} repeats ({hits} residency hits)")
+    print(json.dumps({
+        "metric": "serve_trace_warm_p50_ms",
+        "value": round_keep(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round_keep(cold / p50, 3) if p50 else None,
+        "path": "serve(trace, resident)",
+        "degradations": [],
+        "cold_first_ms": round_keep(cold, 3),
+        "residency_hits": hits,
+        "refs": n_refs,
+    }), flush=True)
+
+
 def bench_import(reps: int = 3) -> None:
     """Frontend ingestion throughput (round r08 on): parse + lower +
     share-span derivation + PR-1 analyzer gate for the checked-in
@@ -1028,6 +1120,11 @@ def main() -> int:
                 bench_serve_warm(24)
             except Exception as e:
                 log(f"bench: serve warm metric failed: {e}")
+        if budget_ok("serve_trace_warm", 90):
+            try:
+                bench_serve_trace_warm(1 << 20, n_requests=6)
+            except Exception as e:
+                log(f"bench: serve trace warm metric failed: {e}")
         if budget_ok("multichip", 240):
             try:
                 bench_multichip(
@@ -1155,6 +1252,13 @@ def main() -> int:
             bench_serve_warm(64)
         except Exception as e:
             log(f"bench: serve warm metric failed: {e}")
+    # warm-trace serving headline (r13): repeat trace requests riding the
+    # residency store vs the cold streamed first request
+    if budget_ok("serve_trace_warm", 120):
+        try:
+            bench_serve_trace_warm()
+        except Exception as e:
+            log(f"bench: serve trace warm metric failed: {e}")
 
     # serving headline (round r07 on): what a tenant of `pluss serve`
     # experiences — p50/p99 latency and req/s, batched vs unbatched A/B

@@ -572,6 +572,14 @@ def main(argv: list[str] | None = None) -> int:
                         "PLUSS_WIRE env, else d24v on accelerators / "
                         "pack on CPU).  Histogram-invariant; part of the "
                         "checkpoint identity")
+    p.add_argument("--resident-cache", default=None,
+                   action=argparse.BooleanOptionalAction,
+                   help="trace mode: keep the staged trace resident in "
+                        "device memory (the r13 HBM residency store) so "
+                        "repeat replays skip host staging entirely; "
+                        "--no-resident-cache forces the plain streamed "
+                        "path.  Default: off for one-shot CLI replays "
+                        "(the daemon enables it per request)")
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="serve mode: unix socket path to listen on")
     p.add_argument("--port", type=int, default=None,
@@ -899,6 +907,8 @@ def main(argv: list[str] | None = None) -> int:
             feed_kw["feed_workers"] = args.feed_workers
         if args.wire is not None:
             feed_kw["wire"] = args.wire
+        res_kw = {"resident_cache": args.resident_cache} \
+            if args.resident_cache is not None else {}
         if backends_explicit and backends != ["shard"]:
             # an explicit backend choice other than exactly 'shard' is
             # silently a no-op here — say so (mirrors the --window notice)
@@ -945,7 +955,7 @@ def main(argv: list[str] | None = None) -> int:
                 rep = trace_mod.shard_replay_file(
                     args.file, cls=cfg.cls, window=win,
                     checkpoint_path=ckpt, resume=args.resume,
-                    dispatch=args.shard_dispatch, **bw_kw)
+                    dispatch=args.shard_dispatch, **bw_kw, **res_kw)
             else:
                 if args.resume or args.journal:
                     print("pluss: --resume/--journal have no effect on "
@@ -972,7 +982,7 @@ def main(argv: list[str] | None = None) -> int:
             rep = replay_file_resilient(args.file, args.fmt, cls=cfg.cls,
                                         window=win, checkpoint_path=ckpt,
                                         resume=args.resume, **bw_kw,
-                                        **feed_kw)
+                                        **feed_kw, **res_kw)
         dt = time.perf_counter() - t0
         if getattr(rep, "degradations", ()):
             # stderr: the stdout block format is diffed byte-for-byte

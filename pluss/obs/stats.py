@@ -386,6 +386,46 @@ def shard_breakdown(counters: dict[str, float],
     return lines
 
 
+def residency_breakdown(counters: dict[str, float],
+                        gauges: dict[str, float]) -> list[str]:
+    """The trace residency block (r13): HBM store hit traffic, eviction
+    and fallback pressure, stage-through population, and the last
+    resident footprint vs budget.  Empty when the stream has no
+    residency activity at all."""
+    hits = counters.get("residency.hit", 0.0)
+    misses = counters.get("residency.miss", 0.0)
+    keys = ("residency.hit", "residency.miss", "residency.evict",
+            "residency.stage_through", "residency.fallback")
+    if not any(counters.get(k) for k in keys):
+        return []
+    lines = ["trace residency:"]
+    total = hits + misses
+    rate = f"  ({100.0 * hits / total:.1f}% hit)" if total else ""
+    lines.append(f"  {'store hits / misses':<28} "
+                 f"{int(hits):>9} / {int(misses)}{rate}")
+    st = counters.get("residency.stage_through")
+    if st:
+        lines.append(f"  {'entries staged through':<28} {int(st):>9}")
+    ev = counters.get("residency.evict")
+    if ev:
+        lines.append(f"  {'LRU evictions':<28} {int(ev):>9}")
+    fb = counters.get("residency.fallback")
+    if fb:
+        lines.append(f"  {'budget fallbacks (streamed)':<28} {int(fb):>9}")
+    pins = counters.get("residency.pin")
+    if pins:
+        lines.append(f"  {'replay pins':<28} {int(pins):>9}")
+    res = gauges.get("trace.hbm_resident_bytes")
+    if res is not None:
+        lines.append(f"  {'resident bytes (last)':<28} "
+                     f"{res / 1e6:>9.1f} MB")
+    qh = gauges.get("serve.queue_hbm_bytes")
+    if qh:
+        lines.append(f"  {'queued HBM demand (last)':<28} "
+                     f"{qh / 1e6:>9.1f} MB")
+    return lines
+
+
 def render(records: list[dict], out) -> None:
     """Write the human report for one loaded stream."""
     n_spans = sum(1 for r in records if r.get("ev") == "span")
@@ -432,6 +472,9 @@ def render(records: list[dict], out) -> None:
     shblock = shard_breakdown(counters, gauges)
     if shblock:
         out.write("\n".join(shblock) + "\n")
+    rblock = residency_breakdown(counters, gauges)
+    if rblock:
+        out.write("\n".join(rblock) + "\n")
 
 
 def main(path: str, out, err, check: bool = False) -> int:

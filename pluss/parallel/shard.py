@@ -92,6 +92,15 @@ def default_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devs[:n]), ("d",))
 
 
+def device_fingerprint(devices) -> tuple:
+    """Stable identity of a device set for cross-run cache keys (the r13
+    residency store): a resident entry staged on one device set must
+    never be served to a replay running on another — a mesh reshape, a
+    force_cpu fallback, or a different device count each change the
+    fingerprint, so the lookup just misses."""
+    return tuple((d.platform, int(d.id)) for d in devices)
+
+
 #: dispatch-mode selector (``dispatch=`` kwarg / ``PLUSS_SHARD_DISPATCH``
 #: env / ``--shard-dispatch``): ``steal`` = host-side work-stealing chunk
 #: dispatcher, ``static`` = the single shard_map program, ``auto`` = steal

@@ -1,0 +1,261 @@
+"""Budgeted device-resident trace store (HBM residency, r13).
+
+The streamed replay path (PR 6) pays host staging — read, compact,
+wire-encode, h2d — on EVERY run of a trace, while the resident kernel
+replays a staged pack at ~12x the streamed rate.  This module keeps the
+staged artifact (the ``[n_batches, bw, window, bpr]`` u8 layout
+:func:`pluss.trace.stage_resident` produces) alive in device memory
+across runs and serve requests, so repeat work replays at resident
+speed with zero feed bytes.
+
+The store is a process-wide singleton (:func:`store`) holding read-only
+entries:
+
+* **keyed** by trace fingerprint + ``WIRE_VERSION`` + layout identity
+  (window, batch grid, fmt, cls, device set) — built by the trace layer
+  (:func:`pluss.trace._residency_key`), opaque here.  A regenerated
+  trace, a wire bump, or a different window/batch grid can never serve
+  stale ids: the key differs, the lookup misses.
+* **byte-accounted** against a budget (``PLUSS_HBM_BUDGET`` bytes,
+  default a conservative fraction of the device's reported memory,
+  parsed via :mod:`pluss.utils.envknob` — a malformed value warns and
+  falls back, never crashes an import).
+* **refcount-pinned** while a replay reads them.  Entries are read-only
+  *inputs* to the replay kernel (the LAT table and histogram are
+  per-replay state), so concurrent tenants share one copy.
+* **LRU-evicted** under pressure.  :meth:`ResidencyStore.reserve` evicts
+  unpinned entries oldest-use-first; when the remaining pinned bytes
+  still don't fit it raises :class:`~pluss.resilience.errors.\
+ResourceExhausted` (degradable, message carries the classifier's
+  ``device budget`` marker) so a miss that can't fit falls back to the
+  PR-6 streamed path through the existing ladder — loudly, and
+  bit-identically.
+
+Counters: ``residency.{hit,miss,evict,pin,stage_through,fallback}``;
+gauge ``trace.hbm_resident_bytes`` tracks the resident footprint.
+``pluss stats`` renders both as the "trace residency" block.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Hashable
+
+from pluss import obs
+from pluss.resilience.errors import ResourceExhausted
+from pluss.utils import envknob
+
+__all__ = [
+    "Entry",
+    "ResidencyStore",
+    "budget_bytes",
+    "device_budget_default",
+    "reset",
+    "store",
+]
+
+# Without PLUSS_HBM_BUDGET the store claims at most this fraction of the
+# device's reported bytes_limit — the replay kernel still needs room for
+# the LAT table, histogram and staging double-buffers beside the cache.
+_DEFAULT_FRACTION = 0.5
+# CPU backend (tier-1) and runtimes that report no memory_stats: a flat
+# conservative default.  Host RAM is the real ceiling there.
+_FALLBACK_BUDGET = 2 << 30
+
+
+def device_budget_default() -> int:
+    """Conservative default budget: half the device's reported memory,
+    or a flat 2 GiB when the runtime reports none (CPU backend)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return max(1, int(limit * _DEFAULT_FRACTION))
+    except Exception:  # noqa: BLE001 — any probe failure means "unknown"
+        pass
+    return _FALLBACK_BUDGET
+
+
+def budget_bytes() -> int:
+    """The effective HBM byte budget (``PLUSS_HBM_BUDGET``, lenient)."""
+    return envknob.env_int("PLUSS_HBM_BUDGET", device_budget_default())
+
+
+@dataclass
+class Entry:
+    """One resident trace: a read-only device value plus its account.
+
+    ``value`` is whatever the producer staged — a single u8
+    ``[n_batches, bw, window, bpr]`` array for the single-device path,
+    or a tuple of per-device chunk arrays for a grouped shard entry.
+    ``n_run``/``n_lines`` pin the replay identity (refs covered and the
+    compactor's final line count): a lookup whose requested prefix
+    differs must MISS, never mask — ``n_lines`` of a shorter prefix is
+    not derivable from the longer one's.
+    """
+
+    key: Hashable
+    value: Any
+    n_lines: int
+    n_run: int
+    nbytes: int
+    meta: dict = field(default_factory=dict)
+    pins: int = 0
+    tick: int = 0
+
+
+class ResidencyStore:
+    """Thread-safe LRU byte-budgeted map of resident trace entries."""
+
+    def __init__(self, budget: int | None = None):
+        if budget is not None and (not isinstance(budget, int)
+                                   or isinstance(budget, bool)
+                                   or budget < 1):
+            raise ValueError(
+                f"residency budget must be a positive int of bytes, "
+                f"got {budget!r}")
+        self._lock = threading.Lock()
+        self._entries: dict[Hashable, Entry] = {}
+        self._tick = 0
+        self._budget = budget
+
+    # -- accounting ---------------------------------------------------------
+
+    def budget(self) -> int:
+        return self._budget if self._budget is not None else budget_bytes()
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def _publish(self) -> None:
+        # under self._lock
+        obs.gauge_set("trace.hbm_resident_bytes",
+                      sum(e.nbytes for e in self._entries.values()))
+
+    # -- lookup / pinning ---------------------------------------------------
+
+    def lookup_pin(self, key: Hashable, *,
+                   n_run: int | None = None) -> Entry | None:
+        """Return the entry for ``key`` pinned (caller must
+        :meth:`unpin`), or ``None`` counted as a miss.  ``n_run``, when
+        given, additionally requires the entry to cover exactly that
+        prefix — a staged longer prefix has a different ``n_lines``, so
+        serving it masked would change the MRC."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and n_run is not None and ent.n_run != n_run:
+                ent = None
+            if ent is None:
+                obs.counter_add("residency.miss")
+                return None
+            ent.pins += 1
+            self._tick += 1
+            ent.tick = self._tick
+            obs.counter_add("residency.hit")
+            obs.counter_add("residency.pin")
+            return ent
+
+    def unpin(self, key: Hashable) -> None:
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and ent.pins > 0:
+                ent.pins -= 1
+
+    # -- admission / eviction -----------------------------------------------
+
+    def reserve(self, nbytes: int, *, site: str = "residency.stage") -> None:
+        """Make room for ``nbytes`` more, LRU-evicting unpinned entries.
+
+        Raises :class:`ResourceExhausted` (degradable; the message
+        carries the ``device budget`` marker the classifier already
+        knows) when the budget can never fit the request — pinned
+        entries are NEVER evicted, so concurrent readers keep their
+        input alive.
+        """
+        budget = self.budget()
+        with self._lock:
+            if nbytes > budget:
+                obs.counter_add("residency.fallback")
+                raise ResourceExhausted(
+                    f"resident trace of {nbytes} bytes exceeds the device "
+                    f"budget of {budget} bytes (PLUSS_HBM_BUDGET)",
+                    site=site)
+            while (sum(e.nbytes for e in self._entries.values()) + nbytes
+                   > budget):
+                victims = [e for e in self._entries.values() if e.pins == 0]
+                if not victims:
+                    obs.counter_add("residency.fallback")
+                    raise ResourceExhausted(
+                        f"cannot fit {nbytes} bytes under the device "
+                        f"budget of {budget} bytes: every resident entry "
+                        f"is pinned by a running replay", site=site)
+                lru = min(victims, key=lambda e: e.tick)
+                del self._entries[lru.key]
+                obs.counter_add("residency.evict")
+            self._publish()
+
+    def put(self, key: Hashable, value: Any, *, n_lines: int, n_run: int,
+            nbytes: int, meta: dict | None = None) -> Entry:
+        """Publish a staged value (replacing any previous entry for the
+        key).  Call :meth:`reserve` first; ``put`` re-checks nothing —
+        the producer already holds the reservation."""
+        with self._lock:
+            self._tick += 1
+            ent = Entry(key=key, value=value, n_lines=int(n_lines),
+                        n_run=int(n_run), nbytes=int(nbytes),
+                        meta=dict(meta or {}), tick=self._tick)
+            self._entries[key] = ent
+            self._publish()
+            return ent
+
+    def discard(self, key: Hashable) -> None:
+        with self._lock:
+            if self._entries.pop(key, None) is not None:
+                self._publish()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._publish()
+
+    # -- introspection ------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": sum(e.nbytes for e in self._entries.values()),
+                "budget": self.budget(),
+                "pinned": sum(1 for e in self._entries.values()
+                              if e.pins > 0),
+            }
+
+
+_store: ResidencyStore | None = None
+_store_lock = threading.Lock()
+
+
+def store() -> ResidencyStore:
+    """The process-wide residency store (lazy singleton)."""
+    global _store
+    with _store_lock:
+        if _store is None:
+            _store = ResidencyStore()
+        return _store
+
+
+def reset(budget: int | None = None) -> ResidencyStore:
+    """Replace the singleton (tests, the smoke's tiny-budget phase).
+    Drops every entry; device buffers free when replays unpin them."""
+    global _store
+    with _store_lock:
+        _store = ResidencyStore(budget)
+        return _store

@@ -1,0 +1,107 @@
+"""HBM trace-residency smoke (run.sh tier-1 gate, r13).
+
+Proves, in seconds on the CPU backend, that the budgeted device-resident
+trace store (:mod:`pluss.residency`) behaves on every PR:
+
+1. one process replays the same trace twice with ``resident_cache=True``:
+   the first run streams and stage-through-populates the store; the
+   second must HIT (``residency.hit`` counted) with ZERO additional feed
+   bytes (``trace.h2d_bytes`` delta == 0) and a bit-identical histogram;
+2. both runs are bit-identical to a plain streamed replay with the store
+   disabled — residency is a pure caching layer, never a result change;
+3. a tiny-budget store (:func:`pluss.residency.reset`) refuses the
+   unfittable staging with a counted fallback — the replay still
+   completes bit-identically through the streamed path, never an OOM
+   crash and never a partial entry left in the store.
+
+Run directly (``python -m pluss.residency_smoke``, telemetry armed by
+run.sh so the counter assertions bite) or through the pytest wrapper in
+tests/test_residency.py.  Pins the CPU backend unless
+``PLUSS_SMOKE_TPU=1`` — the tunneled accelerator can hang, and a tier-1
+gate must not.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+
+def main(n_refs: int = 1 << 19, window: int = 1 << 14,
+         batch_windows: int = 4) -> int:
+    from pluss import obs, residency, trace
+
+    rng = np.random.default_rng(20260805)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "smoke.bin")
+        lines = np.concatenate([
+            rng.integers(0, 1 << 11, n_refs // 2, dtype=np.int64),
+            rng.integers(0, 1 << 15, n_refs - n_refs // 2, dtype=np.int64)])
+        rng.shuffle(lines)
+        (lines.astype(np.uint64) << np.uint64(6)).astype("<u8").tofile(path)
+
+        kw = dict(window=window, batch_windows=batch_windows,
+                  segmented=True, wire="d24v")
+        plain = trace.replay_file(path, **kw)
+        assert plain.total_count == n_refs, \
+            f"streamed replay covered {plain.total_count}/{n_refs} refs"
+
+        # cold run: streams the trace AND stage-through-populates the
+        # store; warm run: must replay the HBM entry with zero feed
+        residency.reset()
+        c0 = obs.counters()
+        cold = trace.replay_file(path, resident_cache=True, **kw)
+        c1 = obs.counters()
+        assert len(residency.store()) == 1, \
+            f"stage-through published {len(residency.store())} entries"
+        warm = trace.replay_file(path, resident_cache=True, **kw)
+        c2 = obs.counters()
+        np.testing.assert_array_equal(cold.hist, plain.hist,
+                                      "cold resident run != plain streamed")
+        np.testing.assert_array_equal(warm.hist, plain.hist,
+                                      "warm resident hit != plain streamed")
+        if obs.enabled():
+            def delta(a, b, k):
+                return b.get(k, 0.0) - a.get(k, 0.0)
+
+            assert delta(c0, c1, "residency.stage_through") >= 1, \
+                f"cold run staged nothing through: {c1}"
+            assert delta(c1, c2, "residency.hit") >= 1, \
+                f"warm run missed the store: {c2}"
+            assert delta(c1, c2, "trace.h2d_bytes") == 0, \
+                "warm resident hit still staged feed bytes over h2d"
+
+        # tiny budget: the staging reservation must refuse (counted
+        # fallback), the replay must still complete bit-identically
+        # through the streamed path, and no partial entry may remain
+        residency.reset(budget=1024)
+        c3 = obs.counters()
+        small = trace.replay_file(path, resident_cache=True, **kw)
+        c4 = obs.counters()
+        assert len(residency.store()) == 0, \
+            "over-budget staging left a partial resident entry"
+        np.testing.assert_array_equal(small.hist, plain.hist,
+                                      "budget-refused run != plain streamed")
+        if obs.enabled():
+            assert c4.get("residency.fallback", 0.0) \
+                - c3.get("residency.fallback", 0.0) >= 1, \
+                f"tiny-budget refusal was not counted: {c4}"
+        residency.reset()
+        obs.flush_metrics()
+
+    print(f"residency smoke OK: {n_refs} refs; warm hit == cold "
+          "stage-through == plain streamed, zero warm feed bytes, "
+          "tiny-budget fallback streamed bit-identically", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    if not os.environ.get("PLUSS_SMOKE_TPU") \
+            and not os.environ.get("JAX_PLATFORMS"):
+        from pluss.utils.platform import force_cpu
+
+        force_cpu()
+    sys.exit(main())

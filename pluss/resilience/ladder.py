@@ -295,6 +295,8 @@ def replay_file_resilient(path: str, fmt: str = "u64", *,
             kw2["feed_workers"] = state["feed_workers"]
         if "wire" in state:
             kw2["wire"] = state["wire"]
+        if "resident_cache" in state:
+            kw2["resident_cache"] = state["resident_cache"]
         return trace.replay_file(path, fmt, **kw2)
 
     def apply_rung(state: dict, rung: str) -> None:
@@ -310,6 +312,11 @@ def replay_file_resilient(path: str, fmt: str = "u64", *,
             state["feed_workers"] = 1
             if not ckpt:
                 state["wire"] = "pack"
+            # the r13 residency store is also shed: if the failure WAS
+            # the resident path (an OOM staging or replaying the HBM
+            # entry), a retry that re-hits the store would just fail the
+            # same way — degrade to the plain streamed feed
+            state["resident_cache"] = False
         elif rung == "shrink_window":
             cur = state.get("window", kw.get("window") or trace.TRACE_WINDOW)
             state["window"] = max(cur // 4, 1 << 14)

@@ -19,7 +19,11 @@ dispatch — batching is the one sanctioned FIFO violation, bounded by the
 batcher's ``max_batch``.
 
 Queue depth is published as the ``serve.queue_depth`` gauge on every
-transition; sheds count under ``serve.shed``.
+transition; sheds count under ``serve.shed``.  Trace requests also carry
+their admission-priced resident-staging footprint (``hbm_bytes``, r13);
+the summed footprint of QUEUED trace work is the ``serve.queue_hbm_bytes``
+gauge — an operator reading ``pluss stats`` sees the HBM demand heading
+for the residency store before it lands.
 """
 
 from __future__ import annotations
@@ -49,6 +53,8 @@ class AdmissionQueue:
 
     def _gauge(self) -> None:
         obs.gauge_set("serve.queue_depth", float(len(self._dq)))
+        obs.gauge_set("serve.queue_hbm_bytes",
+                      float(sum(r.hbm_bytes for r in self._dq)))
 
     def close(self) -> None:
         """Stop admitting; queued requests stay poppable (drain)."""

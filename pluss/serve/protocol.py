@@ -130,6 +130,10 @@ class Request:
     window: int | None = None
     output: str = "mrc"
     sleep_ms: float = 0.0
+    #: projected HBM bytes of the trace's resident staging (trace
+    #: requests; admission-time pricing, r13) — the server serves
+    #: resident only when this fits the residency budget
+    hbm_bytes: int = 0
     #: absolute monotonic deadline (set at admission), None = no deadline
     deadline: float | None = None
     #: monotonic admission instant (latency measurements)
@@ -334,6 +338,24 @@ def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
             raise InvalidRequest(
                 f"request {rid!r}: no such trace file: {path}",
                 site="serve.parse")
+        if fmt == "u64":
+            # admission prices the stream like the spec path prices
+            # static cost (r12): the ref count reads off the file size,
+            # so an oversized trace is refused typed at parse time —
+            # and the projected resident-staging bytes ride the request
+            # so the server can account HBM before serving it resident
+            refs = os.path.getsize(path) // 8
+            bound = max_serve_refs()
+            if refs > bound:
+                raise InvalidRequest(
+                    f"request {rid!r}: trace of {refs} refs exceeds the "
+                    f"per-request bound {bound} (PLUSS_SERVE_MAX_REFS)",
+                    site="serve.admission")
+            from pluss import trace as trace_mod
+
+            win = req.window or trace_mod.TRACE_WINDOW
+            batch = trace_mod.WINDOWS_PER_BATCH * win
+            req.hbm_bytes = -(-max(refs, 1) // batch) * batch * 3
         req.trace, req.fmt = path, fmt
         return req
     # spec request: registry model, inline spec, or frontend-derived

@@ -102,6 +102,14 @@ def _warm_objs(text: str) -> list[dict]:
                         "chunk": _WARM_CHUNK, "id": f"warm-{m}"}
                        for m in REGISTRY)
             continue
+        if os.path.sep in entry or os.path.exists(entry):
+            # a trace path (r13): warm it INTO the residency store so the
+            # first real trace request replays resident.  Path detection
+            # precedes the colon split — model names never contain a
+            # separator, and an existing bare filename is a trace too.
+            out.append({"trace": entry,
+                        "id": f"warm-trace-{os.path.basename(entry)}"})
+            continue
         parts = entry.split(":")
         if len(parts) > 4:
             raise ValueError(
@@ -214,9 +222,17 @@ class Server:
                 return
             try:
                 req = parse_request(obj)
-                with obs.span("serve.warm", model=obj.get("model")):
-                    engine.precompile(req.spec, req.cfg, req.share_cap,
-                                      window_accesses=req.window)
+                if req.kind == "trace":
+                    from pluss import trace as trace_mod
+
+                    with obs.span("serve.warm", trace=req.trace):
+                        trace_mod.ensure_resident(
+                            req.trace, cls=req.cfg.cls,
+                            window=req.window or trace_mod.TRACE_WINDOW)
+                else:
+                    with obs.span("serve.warm", model=obj.get("model")):
+                        engine.precompile(req.spec, req.cfg, req.share_cap,
+                                          window_accesses=req.window)
                 warmed += 1
                 obs.counter_add("serve.warmed")
             except Exception as e:  # noqa: BLE001 — entry-local failure
@@ -551,13 +567,21 @@ class Server:
             self._respond_ok(req, payload, k)
 
     def _execute_trace(self, batch: list[Request]) -> None:
+        from pluss import residency
         from pluss import trace as trace_mod
         from pluss.resilience.ladder import replay_file_resilient
 
         lead = batch[0]
+        # Ride the residency store: a repeat trace replays from HBM with
+        # zero feed bytes.  Admission priced the staging (hbm_bytes, r13)
+        # — an entry the budget can never fit skips the store up front
+        # instead of paying a doomed stage-through; a transient miss
+        # inside still degrades to the streamed path through the ladder.
+        resident = 0 < lead.hbm_bytes <= residency.store().budget()
         rep = replay_file_resilient(
             lead.trace, lead.fmt, cls=lead.cfg.cls,
             window=lead.window or trace_mod.TRACE_WINDOW,
+            resident_cache=resident,
             rungs=SERVE_TRACE_LADDER, retry=Retry(backoff_s=0.01))
         k = len(batch)
         for req in batch:

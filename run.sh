@@ -62,6 +62,20 @@ JAX_PLATFORMS=cpu PLUSS_TELEMETRY="$PLUSS_OBS_LOG" \
 python -m pluss.cli stats "$PLUSS_OBS_LOG" --check 1>&2
 rm -f "$PLUSS_OBS_LOG"
 
+# trace residency smoke (tier-1, r13): replay the same trace twice in one
+# process with the HBM residency store armed — the first run streams and
+# stage-through-populates the store, the second must HIT (residency.hit
+# counted, trace.h2d_bytes delta == 0) bit-identically; then a tiny-budget
+# store must refuse the staging with a counted fallback while the replay
+# completes bit-identically through the streamed path.  Telemetry armed,
+# stream schema-checked — the `pluss stats` trace-residency block reads
+# off this same file.
+PLUSS_RES_LOG=$(mktemp /tmp/pluss_res_XXXX.jsonl)
+JAX_PLATFORMS=cpu PLUSS_TELEMETRY="$PLUSS_RES_LOG" \
+  python -m pluss.residency_smoke 1>&2
+python -m pluss.cli stats "$PLUSS_RES_LOG" --check 1>&2
+rm -f "$PLUSS_RES_LOG"
+
 # multichip smoke (tier-1): 8-fake-device sharded execution — streamed
 # sharded replay (work-stealing AND static dispatch) bit-identical to the
 # single-device replay, quad-nest shard_run (cholesky, the straggler-bound

@@ -473,6 +473,66 @@ def serve(n_requests: int, sd: int, chaos: bool,
                   f"({steady_p50:.1f} ms)")
             failures += 1
 
+        # ---- repeated-trace phase (r13): a FRESH trace at a non-default
+        # window — new XLA shapes, so the first request pays compile +
+        # streaming + stage-through population, while repeats must ride
+        # the daemon's HBM residency store.  r1 cold, r2 first store hit
+        # (pays the resident kernel's compile), r3 warm steady state:
+        # warm must beat cold >= 5x (floored — at trivial cost the bound
+        # would assert on scheduler noise), and all three must be
+        # bit-identical to a solo in-process replay.
+        res_trace = os.path.join(tmp, "refs_resident.bin")
+        # sized for signal on the CPU tier-1 backend: 32k refs at window
+        # 2048 keep the padded staging batch (16 windows x 2048 refs)
+        # small enough that the warm hit's kernel is ~15 ms, while the
+        # cold request still pays the full fresh-shape XLA compile +
+        # stream + stage-through (~450 ms) — the warm/cold gap this
+        # phase asserts on is the store skipping that whole cold side
+        rng_np.integers(0, 2048, 32_000).astype("<u8").tofile(res_trace)
+        res_win = 2048
+        # output=histogram: the bit-identity carrier (the MRC is a pure
+        # function of it, solo-compared in phase 2 already) without the
+        # per-request curve shaping, which would pad cold and warm alike
+        # and drown the residency signal this phase exists to measure
+        rq = {"trace": res_trace, "window": res_win, "output": "histogram"}
+        lat3: list[float] = []
+        resp3: list[dict] = []
+        with Client(sock) as c:
+            for i in range(6):
+                ts = time.perf_counter()
+                r = c.request(dict(rq, id=f"res{i}"))
+                lat3.append((time.perf_counter() - ts) * 1e3)
+                resp3.append(r)
+        # cold = r0 (streams + compiles + stage-through populates); r1 is
+        # the first hit (pays the resident kernel's compile); warm = the
+        # best steady hit after that
+        cold_ms, warm_ms = lat3[0], min(lat3[2:])
+        print(f"serve soak: repeated trace cold {cold_ms:.1f} ms -> warm "
+              f"{warm_ms:.1f} ms ({cold_ms / max(warm_ms, 1e-9):.1f}x)",
+              flush=True)
+        bad3 = [r for r in resp3 if not r.get("ok")]
+        if bad3:
+            print(f"serve soak: FAIL — repeated-trace request(s) failed: "
+                  f"{bad3[:2]}")
+            failures += 1
+        else:
+            cfg3 = SamplerConfig(thread_num=4, chunk_size=4)
+            ri3 = trace.replay_file(res_trace, "u64", cls=cfg3.cls,
+                                    window=res_win).histogram()
+            want_hist = {str(int(k)): float(v)
+                         for k, v in sorted(ri3.items())}
+            for i, r in enumerate(resp3):
+                if r["histogram"] != want_hist:
+                    print(f"serve soak: FAIL — repeated-trace response "
+                          f"res{i} diverged from the solo replay "
+                          f"(degradations={r.get('degradations')})")
+                    failures += 1
+            if not chaos and cold_ms < 5.0 * max(warm_ms, 50.0):
+                print(f"serve soak: FAIL — warm repeated-trace request "
+                      f"({warm_ms:.1f} ms) is not >= 5x faster than the "
+                      f"cold one ({cold_ms:.1f} ms)")
+                failures += 1
+
         # ---- drain and stop
         with Client(sock) as c:
             c.request({"op": "shutdown"})
@@ -480,6 +540,16 @@ def serve(n_requests: int, sd: int, chaos: bool,
         if rc != 0:
             print(f"serve soak: FAIL — daemon exited {rc}; stderr tail:")
             print(open(err_path).read()[-2000:])
+            failures += 1
+        # shutdown flushed cumulative counters into the stream: the
+        # repeated-trace phase must have actually ridden the store
+        try:
+            tel_txt = open(tel).read()
+        except OSError:
+            tel_txt = ""
+        if '"residency.hit"' not in tel_txt:
+            print("serve soak: FAIL — daemon telemetry recorded no "
+                  "residency.hit for the repeated-trace phase")
             failures += 1
     finally:
         if daemon.poll() is None:

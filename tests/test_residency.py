@@ -1,0 +1,458 @@
+"""HBM trace residency (r13): store keying/LRU/pinning semantics,
+bit-identity of resident hits against streamed (and resume-split, and
+ladder-degraded) replays, stage-through byte-identity, the disk pack
+cache, serve tenancy over one shared entry, budget knob validation, the
+`pluss stats` block, and the README contract."""
+
+import io
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (CPU platform + x64)
+from pluss import obs, residency, trace
+from pluss.resilience.errors import DataLoss, ResourceExhausted
+
+
+@pytest.fixture(autouse=True)
+def fresh_store():
+    """Every test gets an empty process store; none leaks entries."""
+    residency.reset()
+    yield
+    residency.reset()
+
+
+def mk_trace(path, n=20_000, hi=1 << 11, seed=5):
+    rng = np.random.default_rng(seed)
+    lines = rng.integers(0, hi, n, dtype=np.int64)
+    (lines << 6).astype("<u8").tofile(path)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# store semantics (no replay involved)
+
+
+def test_store_put_lookup_unpin_stats():
+    st = residency.ResidencyStore(budget=1000)
+    st.reserve(400)
+    st.put("a", b"\0" * 400, n_lines=7, n_run=10, nbytes=400)
+    assert len(st) == 1 and st.used_bytes() == 400
+    ent = st.lookup_pin("a", n_run=10)
+    assert ent is not None and ent.pins == 1 and ent.n_lines == 7
+    # a different replayed prefix must MISS: its n_lines differs and
+    # serving the longer staging masked would change the MRC
+    assert st.lookup_pin("a", n_run=5) is None
+    st.unpin("a")
+    assert st.stats() == {"entries": 1, "bytes": 400, "budget": 1000,
+                          "pinned": 0}
+    st.discard("a")
+    assert len(st) == 0
+    st.discard("a")  # idempotent
+
+
+def test_store_lru_eviction_never_touches_pins():
+    st = residency.ResidencyStore(budget=1000)
+    for key in ("a", "b", "c"):
+        st.reserve(300)
+        st.put(key, key, n_lines=1, n_run=1, nbytes=300)
+    # touch + pin a: it becomes MRU and eviction-proof
+    assert st.lookup_pin("a") is not None
+    st.reserve(300)          # 900 + 300 > 1000: evicts the LRU unpinned = b
+    st.put("d", "d", n_lines=1, n_run=1, nbytes=300)
+    assert st.lookup_pin("b") is None
+    assert st.lookup_pin("c") is not None and st.lookup_pin("d") is not None
+    # now a, c, d are all pinned: nothing is evictable
+    with pytest.raises(ResourceExhausted, match="pinned"):
+        st.reserve(300)
+    st.unpin("a")
+    st.reserve(200)          # frees the now-unpinned LRU (a)
+    assert st.lookup_pin("a") is None
+    assert st.stats()["entries"] == 2
+
+
+def test_store_refuses_oversized_entry_degradably():
+    st = residency.ResidencyStore(budget=1000)
+    with pytest.raises(ResourceExhausted, match="device budget") as ei:
+        st.reserve(2000)
+    assert ei.value.degradable and not ei.value.fatal
+
+
+def test_budget_kwarg_validated():
+    for bad in (0, -5, True, "2G", 1.5):
+        with pytest.raises(ValueError, match="budget"):
+            residency.ResidencyStore(budget=bad)
+    with pytest.raises(ValueError, match="budget"):
+        residency.reset(budget=0)
+    residency.reset()  # leave a valid singleton behind
+
+
+def test_budget_env_knob_lenient(monkeypatch, capsys):
+    monkeypatch.setenv("PLUSS_HBM_BUDGET", "12345")
+    assert residency.budget_bytes() == 12345
+    monkeypatch.setenv("PLUSS_HBM_BUDGET", "a-gigabyte-ish")
+    assert residency.budget_bytes() == residency.device_budget_default()
+    assert "PLUSS_HBM_BUDGET" in capsys.readouterr().err
+    monkeypatch.delenv("PLUSS_HBM_BUDGET")
+    assert residency.budget_bytes() == residency.device_budget_default()
+
+
+# ---------------------------------------------------------------------------
+# keying: regenerated content / wire bump / layout change can never hit
+
+
+def test_residency_key_invalidation(tmp_path, monkeypatch):
+    p = str(tmp_path / "t.bin")
+    mk_trace(p, seed=5)
+    base = dict(cls=64, window=4096, bw=4, precompacted=False)
+    k0 = trace._residency_key(p, **base)
+    mk_trace(p, seed=6)                      # same size, new content
+    assert trace._residency_key(p, **base) != k0
+    mk_trace(p, n=20_001, seed=5)            # new size
+    assert trace._residency_key(p, **base) != k0
+    mk_trace(p, seed=5)                      # restore -> key is stable
+    assert trace._residency_key(p, **base) == k0
+    for change in (dict(cls=128), dict(window=8192), dict(bw=8),
+                   dict(precompacted=True)):
+        assert trace._residency_key(p, **{**base, **change}) != k0
+    monkeypatch.setattr(trace, "WIRE_VERSION", "test-wire-bump")
+    assert trace._residency_key(p, **base) != k0
+
+
+# ---------------------------------------------------------------------------
+# replay bit-identity: hit == stage-through cold == plain streamed
+
+
+def test_resident_hit_bit_identical_to_streamed(tmp_path):
+    p = str(tmp_path / "t.bin")
+    n = mk_trace(p)
+    kw = dict(window=1 << 10, batch_windows=4)
+    plain = trace.replay_file(p, **kw)
+    cold = trace.replay_file(p, resident_cache=True, **kw)
+    assert len(residency.store()) == 1, "stage-through did not publish"
+    warm = trace.replay_file(p, resident_cache=True, **kw)
+    np.testing.assert_array_equal(cold.hist, plain.hist)
+    np.testing.assert_array_equal(warm.hist, plain.hist)
+    assert warm.total_count == plain.total_count == n
+    assert warm.n_lines == plain.n_lines
+    assert residency.store().stats()["pinned"] == 0, \
+        "replay left its entry pinned"
+
+
+def test_resident_hit_matches_resume_split_streamed(tmp_path):
+    """The streamed baseline itself produced across a fault + --resume
+    split; checkpointed/resumed runs must also never publish (their
+    staging is partial by design)."""
+    from pluss.resilience import faults
+
+    rng = np.random.default_rng(59)
+    window, bw = 1 << 8, 2
+    p = str(tmp_path / "t.bin")
+    n = bw * window * 8
+    (rng.integers(0, 1 << 9, n, dtype=np.int64) << 6).astype(
+        "<u8").tofile(p)
+    ckpt = str(tmp_path / "t.ckpt.npz")
+    faults.install(faults.FaultPlan.parse("trace_loss@5"))
+    try:
+        with pytest.raises(DataLoss):
+            trace.replay_file(p, window=window, batch_windows=bw,
+                              resident_cache=True,
+                              checkpoint_path=ckpt, checkpoint_every=1)
+    finally:
+        faults.install(None)
+    ref = trace.replay_file(p, window=window, batch_windows=bw,
+                            resident_cache=True,
+                            checkpoint_path=ckpt, resume=True)
+    assert len(residency.store()) == 0, \
+        "an interrupted/resumed run published a (partial) resident entry"
+    trace.replay_file(p, window=window, batch_windows=bw,
+                      resident_cache=True)
+    warm = trace.replay_file(p, window=window, batch_windows=bw,
+                             resident_cache=True)
+    np.testing.assert_array_equal(warm.hist, ref.hist)
+
+
+def test_ladder_sheds_resident_cache_bit_identically(tmp_path, monkeypatch):
+    """A failure ON the resident path (here: replaying the HBM entry
+    trips a degradable OOM) rides the serve/trace ladder: the
+    serial_feed rung sheds the store and the streamed retry must be
+    bit-identical, stamped as degraded."""
+    from pluss.resilience.ladder import Retry, replay_file_resilient
+
+    p = str(tmp_path / "t.bin")
+    mk_trace(p)
+    kw = dict(window=1 << 10, batch_windows=4)
+    plain = trace.replay_file(p, **kw)
+    trace.replay_file(p, resident_cache=True, **kw)   # populate
+    real = trace.replay_staged
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ResourceExhausted(
+                "synthetic: resident replay blew the device budget",
+                site="test.residency")
+        return real(*a, **k)
+
+    monkeypatch.setattr(trace, "replay_staged", boom)
+    rep = replay_file_resilient(p, resident_cache=True,
+                                retry=Retry(backoff_s=0.01), **kw)
+    assert calls["n"] == 1, "the degraded retry re-entered the store"
+    assert "serial_feed" in rep.degradations
+    np.testing.assert_array_equal(rep.hist, plain.hist)
+
+
+def test_tiny_budget_falls_back_streamed(tmp_path):
+    p = str(tmp_path / "t.bin")
+    mk_trace(p)
+    kw = dict(window=1 << 10, batch_windows=4)
+    plain = trace.replay_file(p, **kw)
+    residency.reset(budget=64)
+    small = trace.replay_file(p, resident_cache=True, **kw)
+    assert len(residency.store()) == 0
+    np.testing.assert_array_equal(small.hist, plain.hist)
+
+
+def test_resident_cache_kwarg_typed(tmp_path):
+    p = str(tmp_path / "t.bin")
+    mk_trace(p, n=200)
+    with pytest.raises(ValueError, match="resident_cache"):
+        trace.replay_file(p, resident_cache="yes")
+    with pytest.raises(ValueError, match="resident_cache"):
+        trace.shard_replay_file(p, resident_cache=1)
+
+
+# ---------------------------------------------------------------------------
+# stage-through byte-identity + explicit population
+
+
+@pytest.mark.parametrize("wire", ["pack", "d24v"])
+def test_stage_through_matches_direct_staging(tmp_path, wire):
+    """The bytes a streaming miss accumulates into the store are exactly
+    the bytes `stage_resident` would upload from the pack — on both the
+    fixed-width and the compressed wire."""
+    p = str(tmp_path / "t.bin")
+    n = mk_trace(p)
+    window, bw = 1 << 10, 4
+    trace.replay_file(p, window=window, batch_windows=bw, wire=wire,
+                      resident_cache=True)
+    key = trace._residency_key(p, cls=64, window=window, bw=bw,
+                               precompacted=False)
+    ent = residency.store().lookup_pin(key, n_run=n)
+    assert ent is not None, "stage-through did not publish"
+    residency.store().unpin(key)
+    packed = str(tmp_path / "direct.pack")
+    meta = trace.pack_file(p, packed, window=window, batch_windows=bw,
+                           wire=wire)
+    direct, n_run, _ = trace.stage_resident(packed, meta, window,
+                                            batch_windows=bw)
+    assert n_run == n == ent.n_run
+    assert ent.n_lines == meta["n_lines"]
+    np.testing.assert_array_equal(np.asarray(ent.value),
+                                  np.asarray(direct))
+
+
+def test_ensure_resident_publishes_then_hits(tmp_path):
+    p = str(tmp_path / "t.bin")
+    mk_trace(p)
+    e1 = trace.ensure_resident(p, window=1 << 10)
+    assert e1.meta["published"] and len(residency.store()) == 1
+    e2 = trace.ensure_resident(p, window=1 << 10)
+    assert e2 is e1, "second ensure_resident re-staged instead of hitting"
+    residency.reset(budget=128)
+    with pytest.raises(ResourceExhausted, match="device budget") as ei:
+        trace.ensure_resident(p, window=1 << 10)
+    assert ei.value.degradable
+
+
+def test_shard_grouped_entry_bit_identical(tmp_path):
+    """The sharded steal path keeps its per-device chunks as ONE grouped
+    store entry; the repeat replay rides it bit-identically."""
+    p = str(tmp_path / "t.bin")
+    rng = np.random.default_rng(17)
+    window = 1 << 8
+    n = 8 * 6 * window
+    (rng.integers(0, 1 << 11, n, dtype=np.int64) << 6).astype(
+        "<u8").tofile(p)
+    ref = trace.replay_file(p, window=window)
+    cold = trace.shard_replay_file(p, window=window, batch_windows=2,
+                                   dispatch="steal", resident_cache=True)
+    assert len(residency.store()) == 1, \
+        f"grouped shard staging published {len(residency.store())} entries"
+    warm = trace.shard_replay_file(p, window=window, batch_windows=2,
+                                   dispatch="steal", resident_cache=True)
+    np.testing.assert_array_equal(cold.hist, ref.hist)
+    np.testing.assert_array_equal(warm.hist, ref.hist)
+    assert len(residency.store()) == 1
+
+
+# ---------------------------------------------------------------------------
+# the disk pack cache (promoted bench `cached_pack`)
+
+
+def test_pack_cached_staleness_and_probe(tmp_path):
+    p = str(tmp_path / "t.bin")
+    mk_trace(p, seed=5)
+    packed = str(tmp_path / "t.pack")
+    kw = dict(window=1 << 10, batch_windows=4, wire="d24v")
+    meta0, cached, pk = trace.pack_cached(p, packed, **kw)
+    assert not cached and pk == packed
+    meta1, cached, _ = trace.pack_cached(p, packed, **kw)
+    assert cached and meta1 == meta0
+    assert os.path.exists(packed + ".json")
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")], \
+        "sidecar write left a temp file behind"
+    # probe mode answers without packing
+    meta2, cached, _ = trace.pack_cached(p, packed, allow_pack=False, **kw)
+    assert cached and meta2 == meta0
+    # regenerated source (same size, new content): stale, never replayed
+    mk_trace(p, seed=6)
+    meta3, cached, _ = trace.pack_cached(p, packed, allow_pack=False, **kw)
+    assert meta3 is None and not cached
+    meta4, cached, _ = trace.pack_cached(p, packed, **kw)
+    assert not cached and meta4["src_fp"] != meta0["src_fp"]
+    # a batch-grid change forces a d24v repack (only stageable at its own
+    # grid); a wire-version bump is covered by the sidecar key itself
+    _, cached, _ = trace.pack_cached(p, packed, window=1 << 10,
+                                     batch_windows=8, wire="d24v")
+    assert not cached
+
+
+# ---------------------------------------------------------------------------
+# serving: tenants share one entry; admission prices the staging
+
+
+def test_concurrent_serve_tenants_share_one_entry(tmp_path):
+    from pluss.serve import Client, ServeConfig, Server
+
+    p = str(tmp_path / "t.bin")
+    mk_trace(p)
+    window = 1 << 10
+    solo = {str(int(k)): float(v)
+            for k, v in sorted(trace.replay_file(
+                p, window=window).histogram().items())}
+    srv = Server(socket_path=str(tmp_path / "s.sock"),
+                 config=ServeConfig(max_batch=4, max_delay_ms=5))
+    srv.start()
+    try:
+        results: dict[str, dict] = {}
+        lock = threading.Lock()
+
+        def tenant(tid):
+            with Client(srv.socket_path) as c:
+                for j in range(2):
+                    r = c.request({"trace": p, "window": window,
+                                   "output": "histogram",
+                                   "id": f"t{tid}-{j}"})
+                    with lock:
+                        results[f"t{tid}-{j}"] = r
+
+        threads = [threading.Thread(target=tenant, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        srv.shutdown(drain_timeout_s=30)
+    assert len(results) == 6
+    for rid, r in results.items():
+        assert r.get("ok"), f"{rid}: {r}"
+        assert r["histogram"] == solo, f"{rid} diverged from the solo run"
+    assert len(residency.store()) == 1, \
+        "concurrent tenants did not share one resident entry"
+
+
+def test_serve_trace_request_priced_and_bounded(tmp_path, monkeypatch):
+    from pluss.serve.protocol import InvalidRequest, parse_request
+
+    p = str(tmp_path / "t.bin")
+    mk_trace(p, n=20_000)
+    req = parse_request({"trace": p, "window": 1 << 10})
+    batch = trace.WINDOWS_PER_BATCH * (1 << 10)
+    assert req.hbm_bytes == -(-20_000 // batch) * batch * 3
+    monkeypatch.setenv("PLUSS_SERVE_MAX_REFS", "1999")
+    with pytest.raises(InvalidRequest, match="PLUSS_SERVE_MAX_REFS"):
+        parse_request({"trace": p})
+
+
+# ---------------------------------------------------------------------------
+# observability + docs contracts
+
+
+def test_stats_residency_block_render():
+    from pluss.obs.stats import residency_breakdown
+
+    lines = residency_breakdown(
+        {"residency.hit": 3, "residency.miss": 1, "residency.evict": 2,
+         "residency.stage_through": 1, "residency.fallback": 1,
+         "residency.pin": 3},
+        {"trace.hbm_resident_bytes": 1.6e6, "serve.queue_hbm_bytes": 0.0})
+    assert lines[0] == "trace residency:"
+    text = "\n".join(lines)
+    assert "store hits / misses" in text and "75.0% hit" in text
+    assert "LRU evictions" in text
+    assert "budget fallbacks (streamed)" in text
+    assert "resident bytes (last)" in text and "1.6 MB" in text
+    assert residency_breakdown({}, {}) == []
+    assert residency_breakdown({"trace.h2d_s": 1.0},
+                               {"trace.hbm_resident_bytes": 5.0}) == []
+
+
+def test_residency_telemetry_counters(tmp_path):
+    """Armed telemetry: one miss + stage-through on the cold run, one
+    hit + pin on the warm, zero h2d on the warm, and the rendered block
+    comes out of `pluss stats` on the emitted stream."""
+    from pluss.obs import stats as stats_mod
+
+    p = str(tmp_path / "t.bin")
+    mk_trace(p)
+    kw = dict(window=1 << 10, batch_windows=4)
+    sink = tmp_path / "tel.jsonl"
+    obs.configure(str(sink))
+    try:
+        trace.replay_file(p, resident_cache=True, **kw)
+        c1 = obs.counters()
+        trace.replay_file(p, resident_cache=True, **kw)
+        cs, gs = obs.counters(), obs.gauges()
+        obs.flush_metrics()
+    finally:
+        obs.shutdown()
+    assert cs["residency.miss"] >= 1 and cs["residency.stage_through"] == 1
+    assert cs["residency.hit"] == 1 and cs["residency.pin"] == 1
+    assert cs.get("trace.h2d_bytes", 0) == c1.get("trace.h2d_bytes", 0), \
+        "the warm hit still fed bytes over h2d"
+    assert gs["trace.hbm_resident_bytes"] > 0
+    records, problems, _ = stats_mod.load(str(sink))
+    assert not problems, problems
+    out = io.StringIO()
+    stats_mod.render(records, out)
+    assert "trace residency:" in out.getvalue()
+
+
+def test_readme_residency_section_in_sync():
+    readme = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "README.md")
+    with open(readme) as f:
+        text = f.read()
+    assert "## Trace residency" in text
+    for needle in ("PLUSS_HBM_BUDGET", "--resident-cache",
+                   "--no-resident-cache", "resident_cache=True",
+                   "trace.hbm_resident_bytes", "serve.queue_hbm_bytes",
+                   "trace residency:", "residency.fallback",
+                   "stage_through", "replay_staged", "pack_cached",
+                   "residency_smoke"):
+        assert needle in text, f"README residency section lost {needle!r}"
+
+
+def test_residency_smoke_wrapper():
+    """The run.sh tier-1 smoke, importable: warm hit == cold
+    stage-through == plain streamed; tiny-budget fallback bit-identical."""
+    from pluss import residency_smoke
+
+    assert residency_smoke.main(n_refs=1 << 17, window=1 << 12,
+                                batch_windows=4) == 0
