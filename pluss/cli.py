@@ -617,6 +617,22 @@ def main(argv: list[str] | None = None) -> int:
                         "name[:n[:threads[:chunk]]] entries, or 'all' for "
                         "every registry model) so first requests dispatch "
                         "warm")
+    p.add_argument("--journal-dir", default=None, metavar="DIR",
+                   help="serve mode: crash-safe request journal directory "
+                        "(also PLUSS_SERVE_JOURNAL) — accepted requests "
+                        "are journaled before dispatch and marked done on "
+                        "reply, so a restart replays what was lost")
+    p.add_argument("--recover", default=None, metavar="DIR",
+                   help="serve mode: recover from the request journal in "
+                        "DIR at startup (implies --journal-dir DIR): "
+                        "still-open entries replay through normal "
+                        "admission and their answers park for "
+                        '{"op": "result", "id": rid} collection')
+    p.add_argument("--drain-timeout-s", type=float, default=60.0,
+                   help="serve mode: HARD bound on shutdown drain — past "
+                        "it, still-pending requests are answered typed "
+                        "retryable and the daemon exits 0 (a supervisor "
+                        "restart with --recover serves them)")
     p.add_argument("--xla-cache", default=None, metavar="DIR",
                    help="arm JAX's persistent compilation cache in DIR "
                         "(default $PLUSS_XLA_CACHE_DIR when set): compiled "
@@ -762,6 +778,8 @@ def main(argv: list[str] | None = None) -> int:
             heartbeat_dir=args.heartbeat_dir,
             num_processes=args.num_processes,
             warm=args.warm,
+            journal_dir=args.recover or args.journal_dir,
+            drain_timeout_s=args.drain_timeout_s,
         )
         server = Server(socket_path=args.socket, port=args.port,
                         host=args.host, config=scfg)

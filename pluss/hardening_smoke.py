@@ -1,0 +1,148 @@
+"""Fleet-hardening smoke (run.sh tier-1 gate, r14).
+
+Proves, in seconds on the CPU backend, that the serve hardening layer
+behaves on every PR:
+
+1. a fresh daemon answers ``{"op": "health"}`` (breaker closed) and
+   ``{"op": "ready"}`` (ready, no reasons);
+2. two injected device dispatch failures
+   (``dispatch_fail@1,dispatch_fail@2`` at ``serve.dispatch``, breaker
+   threshold 2) TRIP the circuit breaker: health reports ``open`` and
+   ready goes false naming the breaker;
+3. while open, a spec request BROWNS OUT — served on the host CPU
+   device, stamped ``cpu_brownout``, bit-identical to the clean run —
+   and a trace request is SHED typed ``Overloaded`` carrying
+   ``retry_after_ms``;
+4. after the cooldown the half-open probe closes the breaker: health
+   reports ``closed``, ready is true again;
+5. the ``serve.breaker.{open,close,brownout,shed}`` counters all moved,
+   and every admitted request was journaled and marked done.
+
+Run directly (``python -m pluss.hardening_smoke``, telemetry armed by
+run.sh so the counter assertions and the ``pluss stats`` hardening
+block bite) or through the pytest wrapper in
+tests/test_serve_hardening.py.  Pins the CPU backend unless
+``PLUSS_SMOKE_TPU=1`` — the tunneled accelerator can hang, and a tier-1
+gate must not.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_SPEC = {"model": "gemm", "n": 16, "threads": 2, "chunk": 2,
+         "output": "both"}
+
+
+def main() -> int:
+    from pluss import obs
+    from pluss.resilience import faults
+    from pluss.serve.protocol import Client
+    from pluss.serve.server import ServeConfig, Server
+
+    c0 = obs.counters()
+    with tempfile.TemporaryDirectory() as td:
+        trace_path = os.path.join(td, "smoke_trace.bin")
+        rng = np.random.default_rng(20260805)
+        (rng.integers(0, 1 << 10, 1 << 12).astype(np.uint64)
+         << np.uint64(6)).astype("<u8").tofile(trace_path)
+
+        srv = Server(socket_path=os.path.join(td, "s.sock"),
+                     config=ServeConfig(journal_dir=td,
+                                        breaker_threshold=2,
+                                        breaker_window_s=30.0,
+                                        breaker_cooldown_s=0.5))
+        srv.start()
+        try:
+            with Client(srv.address) as cl:
+                h = cl.request({"op": "health"})
+                assert h["ok"] and h["breaker"] == "closed", \
+                    f"fresh daemon not healthy/closed: {h}"
+                rd = cl.request({"op": "ready"})
+                assert rd["ready"] and not rd["reasons"], \
+                    f"fresh daemon not ready: {rd}"
+
+                clean = cl.request(dict(_SPEC))
+                assert clean["ok"] and not clean.get("degradations"), \
+                    f"clean baseline failed: {clean}"
+
+                # trip the breaker: two classified device failures
+                faults.install(faults.FaultPlan.parse(
+                    "dispatch_fail@1,dispatch_fail@2"))
+                for i in range(2):
+                    r = cl.request(dict(_SPEC))
+                    assert not r["ok"] \
+                        and r["error"]["type"] == "ResourceExhausted", \
+                        f"injected failure {i} not classified: {r}"
+                h = cl.request({"op": "health"})
+                assert h["breaker"] == "open", \
+                    f"breaker did not open after 2 failures: {h}"
+                rd = cl.request({"op": "ready"})
+                assert not rd["ready"] \
+                    and any("breaker" in s for s in rd["reasons"]), \
+                    f"open breaker did not gate readiness: {rd}"
+
+                # open breaker: spec browns out bit-identically on CPU...
+                bo = cl.request(dict(_SPEC))
+                assert bo["ok"] \
+                    and "cpu_brownout" in bo.get("degradations", ()), \
+                    f"spec did not brown out: {bo}"
+                assert bo["mrc"] == clean["mrc"] \
+                    and bo["histogram"] == clean["histogram"], \
+                    "brown-out result != clean-run result"
+                # ...and trace replay sheds typed with a back-off hint
+                sh = cl.request({"trace": trace_path, "fmt": "u64"})
+                assert not sh["ok"] \
+                    and sh["error"]["type"] == "Overloaded" \
+                    and sh["error"].get("retry_after_ms", 0) > 0, \
+                    f"trace was not shed typed while open: {sh}"
+
+                # cooldown -> half-open -> successful probe closes it
+                time.sleep(0.7)
+                pr = cl.request(dict(_SPEC))
+                assert pr["ok"] and not pr.get("degradations"), \
+                    f"half-open probe failed: {pr}"
+                h = cl.request({"op": "health"})
+                assert h["breaker"] == "closed", \
+                    f"breaker did not close after the probe: {h}"
+                rd = cl.request({"op": "ready"})
+                assert rd["ready"], f"closed breaker still gates: {rd}"
+        finally:
+            faults.install(None)
+            srv.shutdown(drain_timeout_s=30)
+
+    if obs.enabled():
+        c1 = obs.counters()
+
+        def delta(k):
+            return c1.get(k, 0.0) - c0.get(k, 0.0)
+
+        for k in ("serve.breaker.open", "serve.breaker.close",
+                  "serve.breaker.brownout", "serve.breaker.shed"):
+            assert delta(k) >= 1, f"{k} did not move: {c1}"
+        assert delta("serve.journal.appended") >= 5, \
+            f"admitted requests were not journaled: {c1}"
+        assert delta("serve.journal.appended") \
+            == delta("serve.journal.completed"), \
+            "journal entries left open after a clean drain"
+    obs.flush_metrics()
+
+    print("hardening smoke OK: breaker tripped on 2 injected dispatch "
+          "failures, spec browned out bit-identically on CPU, trace shed "
+          "typed with retry_after_ms, half-open probe closed it; journal "
+          "appended == completed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    if not os.environ.get("PLUSS_SMOKE_TPU") \
+            and not os.environ.get("JAX_PLATFORMS"):
+        from pluss.utils.platform import force_cpu
+
+        force_cpu()
+    sys.exit(main())

@@ -426,6 +426,79 @@ def residency_breakdown(counters: dict[str, float],
     return lines
 
 
+_BREAKER_STATE = {0: "closed", 1: "half_open", 2: "open"}
+
+
+def hardening_breakdown(counters: dict[str, float],
+                        gauges: dict[str, float]) -> list[str]:
+    """The fleet-hardening block (r14): request-journal traffic and
+    recovery, circuit-breaker trips with brown-out/shed volume, watchdog
+    abandons, tenant rate-limit sheds, and connection-layer protection.
+    Empty when none of the hardening machinery fired (a healthy daemon
+    with no journal configured prints nothing here)."""
+    keys = ("serve.journal.appended", "serve.journal.recovered",
+            "serve.breaker.open", "serve.breaker.brownout",
+            "serve.breaker.shed", "serve.watchdog.abandoned",
+            "serve.fairness.rate_limited", "serve.conn_shed",
+            "serve.conn_idle_closed", "serve.drain_forced")
+    if not any(counters.get(k) for k in keys) \
+            and gauges.get("serve.breaker.state") is None:
+        return []
+    lines = ["serve hardening:"]
+    app = counters.get("serve.journal.appended")
+    if app or counters.get("serve.journal.completed"):
+        comp = counters.get("serve.journal.completed", 0.0)
+        lines.append(f"  {'journal appended / done':<28} "
+                     f"{int(app or 0):>9} / {int(comp)}")
+    rec = counters.get("serve.journal.recovered")
+    if rec:
+        exp = counters.get("serve.journal.expired", 0.0)
+        lines.append(f"  {'recovered (of them expired)':<28} "
+                     f"{int(rec):>9}  ({int(exp)} expired)")
+    rot = counters.get("serve.journal.rotations")
+    if rot:
+        lines.append(f"  {'journal compactions':<28} {int(rot):>9}")
+    jfail = counters.get("serve.journal.append_fail")
+    if jfail:
+        lines.append(f"  {'journal append failures':<28} {int(jfail):>9}")
+    opens = counters.get("serve.breaker.open")
+    if opens or gauges.get("serve.breaker.state") is not None:
+        closes = counters.get("serve.breaker.close", 0.0)
+        reopens = counters.get("serve.breaker.reopen", 0.0)
+        state = gauges.get("serve.breaker.state")
+        now = f"  (now {_BREAKER_STATE.get(int(state), '?')})" \
+            if state is not None else ""
+        lines.append(f"  {'breaker open/close/reopen':<28} "
+                     f"{int(opens or 0):>9} / {int(closes)} / "
+                     f"{int(reopens)}{now}")
+    bo = counters.get("serve.breaker.brownout")
+    if bo:
+        lines.append(f"  {'spec brown-outs (cpu)':<28} {int(bo):>9}")
+    bs = counters.get("serve.breaker.shed")
+    if bs:
+        lines.append(f"  {'trace sheds (breaker open)':<28} {int(bs):>9}")
+    ab = counters.get("serve.watchdog.abandoned")
+    if ab:
+        abr = counters.get("serve.watchdog.abandoned_requests", 0.0)
+        lines.append(f"  {'watchdog abandons':<28} {int(ab):>9}  "
+                     f"({int(abr)} request(s) answered retryable)")
+    rl = counters.get("serve.fairness.rate_limited")
+    if rl:
+        lines.append(f"  {'tenant rate-limit sheds':<28} {int(rl):>9}")
+    at = gauges.get("serve.fairness.active_tenants")
+    if at:
+        lines.append(f"  {'active tenants (last)':<28} {_fmt_val(at):>9}")
+    cs = counters.get("serve.conn_shed")
+    ic = counters.get("serve.conn_idle_closed")
+    if cs or ic:
+        lines.append(f"  {'conns shed / idle-closed':<28} "
+                     f"{int(cs or 0):>9} / {int(ic or 0)}")
+    df = counters.get("serve.drain_forced")
+    if df:
+        lines.append(f"  {'forced drains':<28} {int(df):>9}")
+    return lines
+
+
 def render(records: list[dict], out) -> None:
     """Write the human report for one loaded stream."""
     n_spans = sum(1 for r in records if r.get("ev") == "span")
@@ -475,6 +548,9 @@ def render(records: list[dict], out) -> None:
     rblock = residency_breakdown(counters, gauges)
     if rblock:
         out.write("\n".join(rblock) + "\n")
+    hblock = hardening_breakdown(counters, gauges)
+    if hblock:
+        out.write("\n".join(hblock) + "\n")
 
 
 def main(path: str, out, err, check: bool = False) -> int:

@@ -23,6 +23,11 @@ story every entry point shares:
   and stamping every result with the degradations taken.
 - :mod:`pluss.resilience.journal` — the atomic JSONL checkpoint journal
   behind ``sweep --resume`` and the trace staging/replay checkpoints.
+- :mod:`pluss.resilience.breaker` — the device circuit breaker
+  (closed → open after N classified failures in a window → half-open
+  probe, jittered doubling cooldown) the serving layer wraps around
+  device dispatch to brown out / shed instead of re-failing at full
+  price on a flapping device.
 
 Everything here is host-side control flow — no new device code, no new
 dependencies — so the same recovery semantics hold on CPU and TPU.
@@ -44,6 +49,7 @@ from pluss.resilience.errors import (
     WorkerDied,
     classify,
 )
+from pluss.resilience.breaker import CircuitBreaker
 from pluss.resilience.faults import FaultPlan
 from pluss.resilience.journal import Journal
 from pluss.resilience.ladder import (
@@ -58,6 +64,6 @@ __all__ = [
     "PlussError", "ResourceExhausted", "CompileError", "ShareCapOverflow",
     "CollectiveError", "WorkerDied", "DataLoss", "CacheCorrupt",
     "Overloaded", "DeadlineExceeded", "InvalidRequest", "classify",
-    "FaultPlan", "Journal", "LADDER", "SERVE_LADDER", "Retry",
-    "run_resilient", "replay_file_resilient",
+    "CircuitBreaker", "FaultPlan", "Journal", "LADDER", "SERVE_LADDER",
+    "Retry", "run_resilient", "replay_file_resilient",
 ]

@@ -125,9 +125,20 @@ class Overloaded(PlussError):
     """The serving admission bound is full: the request was SHED before
     any work happened (``pluss.serve.admission``).  Retryable — from the
     *client's* side, after backing off; the server itself never retries a
-    shed request (that would amplify the overload it protects against)."""
+    shed request (that would amplify the overload it protects against).
+
+    ``retry_after_ms``, when set, names the back-off the shedding layer
+    suggests (time to the next token for a rate-limited tenant, the
+    breaker's next probe slot, …) and is surfaced on the wire by
+    ``protocol.error_response``."""
 
     retryable = True
+
+    def __init__(self, message: str, site: str = "",
+                 cause: BaseException | None = None,
+                 retry_after_ms: int | None = None):
+        super().__init__(message, site, cause)
+        self.retry_after_ms = retry_after_ms
 
 
 class DeadlineExceeded(PlussError):

@@ -20,6 +20,12 @@ fault kind          site                    effect at the armed hit
 ``trace_loss``      ``trace.read_batch``    raises ``DataLoss`` mid-stream
 ``collective``      ``multihost.init``      raises a connect failure
 ``kill_worker``     ``multihost.heartbeat`` ``os._exit(43)`` on process ``n``
+``hang``            ``serve.dispatch``      sleeps ``PLUSS_FAULT_HANG_S``
+                                            (default 30 s) — wedged-XLA stand-in
+                                            for the serve watchdog
+``dispatch_fail``   ``serve.dispatch``      raises a synthetic device failure
+                                            (``RESOURCE_EXHAUSTED``) before the
+                                            ladder — trips the serve breaker
 ==================  ======================  =================================
 
 Plan grammar (``PLUSS_FAULT_PLAN``): comma-separated ``kind`` or
@@ -51,6 +57,8 @@ KIND_SITE: dict[str, str] = {
     "trace_loss": "trace.read_batch",
     "collective": "multihost.init",
     "kill_worker": "multihost.heartbeat",
+    "hang": "serve.dispatch",
+    "dispatch_fail": "serve.dispatch",
 }
 
 #: kinds safe for the single-process chaos soak (no process killing, no
@@ -148,6 +156,20 @@ class FaultPlan:
             raise DataLoss(f"trace bytes lost mid-stream {tag}", site=site)
         if e.kind == "collective":
             raise ConnectionError(f"failed to connect to coordinator {tag}")
+        if e.kind == "hang":
+            # the wedged-XLA stand-in: block the dispatching thread long
+            # enough for the serve watchdog to abandon it, then return
+            # normally (the stale device loop must exit on its own)
+            import time
+
+            from pluss.utils.envknob import env_float
+
+            time.sleep(env_float("PLUSS_FAULT_HANG_S", 30.0, minimum=0.0))
+            return
+        if e.kind == "dispatch_fail":
+            raise RuntimeError(
+                f"RESOURCE_EXHAUSTED: injected device dispatch failure "
+                f"{tag}")
         raise AssertionError(f"unhandled fault kind {e.kind}")
 
     def corrupt(self, site: str, path: str) -> bool:

@@ -77,16 +77,33 @@ SERVE_LADDER: tuple[str, ...] = ("shrink_window", "raise_n_windows",
 
 @dataclasses.dataclass
 class Retry:
-    """Bounded exponential backoff shared by every resilient loop."""
+    """Bounded exponential backoff shared by every resilient loop.
+
+    The sleep is FULL-jitter (``U(0, min(backoff*2^attempt, cap))``):
+    a deterministic exponential schedule synchronizes retry storms —
+    every tenant/worker that failed together re-arrives together, at
+    exactly the moment the device is trying to recover.  ``jitter_seed``
+    pins the draw sequence for reproducible fault-plan tests; ``None``
+    (the default) seeds from the OS so real fleets desynchronize.
+    Jittered sleeps only ever SHRINK relative to the old deterministic
+    schedule, so no existing timeout budget gets tighter.
+    """
 
     max_attempts: int = 8
     backoff_s: float = 0.05
     backoff_cap_s: float = 2.0
+    jitter_seed: int | None = None
+
+    def __post_init__(self) -> None:
+        import random
+
+        self._rng = random.Random(self.jitter_seed)
 
     def sleep(self, attempt: int) -> None:
         if self.backoff_s > 0:
-            time.sleep(min(self.backoff_s * (2 ** attempt),
-                           self.backoff_cap_s))
+            bound = min(self.backoff_s * (2 ** attempt),
+                        self.backoff_cap_s)
+            time.sleep(bound * self._rng.random())
 
 
 def _log(msg: str) -> None:

@@ -11,8 +11,11 @@ pieces:
 - :mod:`pluss.serve.admission` — the bounded shed-don't-block queue;
 - :mod:`pluss.serve.batcher`   — shared-dispatch coalescing of
   plan-compatible requests (max-delay/max-batch adaptive window);
+- :mod:`pluss.serve.journal`   — the crash-safe request journal behind
+  ``--journal-dir`` / ``--recover`` (open on admission, done on reply);
 - :mod:`pluss.serve.server`    — the daemon: listener, device loop,
-  per-request resilience ladder, SLO gauges, drain-and-stop.
+  per-request resilience ladder, watchdog + circuit breaker, SLO
+  gauges, drain-and-stop.
 
 Start one with ``pluss serve --socket /tmp/pluss.sock`` (or ``--port``),
 load it with ``python soak.py --serve N``, and read its SLOs with
@@ -21,6 +24,7 @@ load it with ``python soak.py --serve N``, and read its SLOs with
 
 from pluss.serve.admission import AdmissionQueue  # noqa: F401
 from pluss.serve.batcher import Batcher  # noqa: F401
+from pluss.serve.journal import RequestJournal  # noqa: F401
 from pluss.serve.protocol import (  # noqa: F401
     Client,
     Request,
@@ -31,6 +35,7 @@ from pluss.serve.protocol import (  # noqa: F401
 from pluss.serve.server import ServeConfig, Server  # noqa: F401
 
 __all__ = [
-    "AdmissionQueue", "Batcher", "Client", "Request", "parse_request",
-    "spec_from_json", "spec_to_json", "ServeConfig", "Server",
+    "AdmissionQueue", "Batcher", "Client", "Request", "RequestJournal",
+    "parse_request", "spec_from_json", "spec_to_json", "ServeConfig",
+    "Server",
 ]

@@ -1,60 +1,105 @@
-"""Admission control: a bounded request queue that SHEDS, never blocks.
+"""Admission control: a bounded, tenant-fair request queue that SHEDS.
 
 The serving failure mode this module exists for: under overload an
 unbounded queue converts every request into a slow request (everyone
 waits behind everyone), while a blocking bounded queue converts the
 ACCEPT path into the bottleneck (connection handlers wedge, clients see
 silence).  The correct shape — the one every production admission layer
-converges on — is a bounded FIFO whose ``submit`` fails FAST with a
+converges on — is a bounded queue whose ``submit`` fails FAST with a
 typed :class:`~pluss.resilience.errors.Overloaded` the client can key
 backoff on, so the deepest a request can ever queue is ``max_queue``
 dispatches' worth of work.
+
+Fairness (r14) is two mechanisms layered on that bound:
+
+- **Deficit round-robin pop**: requests queue per ``tenant`` id and
+  ``pop`` serves the tenants in DRR order (quantum = cost = one
+  request), so a flooding client fills only ITS deque — everyone else
+  still gets one pop per ring pass.  A single tenant (the anonymous
+  ``""`` included) degenerates to the exact old FIFO.
+- **Token-bucket rate limit** at ``submit`` (``PLUSS_SERVE_TENANT_RPS``
+  / ``PLUSS_SERVE_TENANT_BURST``; 0 rps = off, the default): a tenant
+  over its refill rate is shed typed, and the shed carries
+  ``retry_after_ms`` — the time to its next token — so clients back off
+  by instruction instead of by guesswork.
 
 The queue also owns deadline hygiene on the way OUT: ``pop`` lazily
 drops requests that expired while queued (returning them separately so
 the server can answer each with a typed ``DeadlineExceeded`` — a shed
 response beats a mystery timeout), and ``take_matching`` lets the
 batcher coalesce compatible requests from ANYWHERE in the queue onto one
-dispatch — batching is the one sanctioned FIFO violation, bounded by the
-batcher's ``max_batch``.
+dispatch — batching is the one sanctioned ordering violation, bounded by
+the batcher's ``max_batch``.
 
 Queue depth is published as the ``serve.queue_depth`` gauge on every
-transition; sheds count under ``serve.shed``.  Trace requests also carry
-their admission-priced resident-staging footprint (``hbm_bytes``, r13);
-the summed footprint of QUEUED trace work is the ``serve.queue_hbm_bytes``
-gauge — an operator reading ``pluss stats`` sees the HBM demand heading
-for the residency store before it lands.
+transition (with ``serve.queue_hbm_bytes`` and
+``serve.fairness.active_tenants`` alongside); sheds count under
+``serve.shed``, rate-limit sheds additionally under
+``serve.fairness.rate_limited``.
 """
 
 from __future__ import annotations
 
 import collections
 import threading
+import time
 
 from pluss import obs
 from pluss.resilience.errors import Overloaded
 from pluss.serve.protocol import Request
 
+#: DRR quantum and per-request cost.  Equal by design: every tenant with
+#: queued work gets exactly one request served per ring pass — request
+#: count IS the fairness currency here (admission already bounds each
+#: request's device cost via the static pricing gate, so weighting by
+#: predicted cost would double-charge).
+_QUANTUM = 1.0
+_COST = 1.0
+
+#: hostile-tenant guard: the token-bucket table never grows past this
+#: (full, idle buckets are evicted first — they hold no state a refill
+#: wouldn't recreate)
+_MAX_BUCKETS = 4096
+
+#: suggested client back-off for a queue-full shed, where no token-refill
+#: instant exists to derive one from
+_FULL_RETRY_MS = 100
+
 
 class AdmissionQueue:
-    """Bounded FIFO of admitted requests (thread-safe)."""
+    """Bounded tenant-fair queue of admitted requests (thread-safe)."""
 
-    def __init__(self, max_queue: int = 128):
+    def __init__(self, max_queue: int = 128, tenant_rps: float = 0.0,
+                 tenant_burst: float | None = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if tenant_rps < 0:
+            raise ValueError(f"tenant_rps must be >= 0, got {tenant_rps}")
         self.max_queue = max_queue
-        self._dq: collections.deque[Request] = collections.deque()
+        self.tenant_rps = float(tenant_rps)
+        self.tenant_burst = float(tenant_burst) if tenant_burst \
+            else max(1.0, 2.0 * self.tenant_rps)
+        # invariant: a tenant is in _q iff it is in _ring; pop retires
+        # emptied tenants from both together
+        self._q: dict[str, collections.deque[Request]] = {}
+        self._ring: collections.deque[str] = collections.deque()
+        self._deficit: dict[str, float] = {}
+        self._buckets: dict[str, list[float]] = {}   # tenant -> [tokens, t]
+        self._count = 0
         self._cv = threading.Condition()
         self._closed = False
 
     def __len__(self) -> int:
         with self._cv:
-            return len(self._dq)
+            return self._count
 
     def _gauge(self) -> None:
-        obs.gauge_set("serve.queue_depth", float(len(self._dq)))
+        obs.gauge_set("serve.queue_depth", float(self._count))
         obs.gauge_set("serve.queue_hbm_bytes",
-                      float(sum(r.hbm_bytes for r in self._dq)))
+                      float(sum(r.hbm_bytes for dq in self._q.values()
+                                for r in dq)))
+        obs.gauge_set("serve.fairness.active_tenants",
+                      float(sum(1 for dq in self._q.values() if dq)))
 
     def close(self) -> None:
         """Stop admitting; queued requests stay poppable (drain)."""
@@ -62,38 +107,78 @@ class AdmissionQueue:
             self._closed = True
             self._cv.notify_all()
 
+    # ------------------------------------------------------------------
+    # submit side: bound + token bucket
+
     def submit(self, req: Request) -> None:
         """Enqueue or shed.  Raises :class:`Overloaded` when the bound is
-        reached or the queue is draining — the caller answers the client
-        with the typed error; nothing ever blocks here."""
+        reached, the queue is draining, or the request's tenant is over
+        its rate limit — the caller answers the client with the typed
+        error; nothing ever blocks here."""
         with self._cv:
             if self._closed:
                 obs.counter_add("serve.shed")
                 raise Overloaded("server is draining; not admitting",
                                  site="serve.admission")
-            if len(self._dq) >= self.max_queue:
+            if self._count >= self.max_queue:
                 obs.counter_add("serve.shed")
                 raise Overloaded(
                     f"admission queue full ({self.max_queue} deep); "
-                    "back off and retry", site="serve.admission")
-            self._dq.append(req)
+                    "back off and retry", site="serve.admission",
+                    retry_after_ms=_FULL_RETRY_MS)
+            retry_ms = self._take_token(req.tenant)
+            if retry_ms is not None:
+                obs.counter_add("serve.shed")
+                obs.counter_add("serve.fairness.rate_limited")
+                raise Overloaded(
+                    f"tenant {req.tenant or 'default'!r} over its rate "
+                    f"limit ({self.tenant_rps:g} rps); back off",
+                    site="serve.admission",
+                    retry_after_ms=int(retry_ms) + 1)
+            dq = self._q.get(req.tenant)
+            if dq is None:
+                dq = self._q[req.tenant] = collections.deque()
+                self._ring.append(req.tenant)
+            dq.append(req)
+            self._count += 1
             self._gauge()
             self._cv.notify()
 
+    def _take_token(self, tenant: str) -> float | None:
+        """None admits (one token consumed); otherwise the milliseconds
+        until this tenant's next token."""
+        if self.tenant_rps <= 0:
+            return None
+        now = time.monotonic()
+        b = self._buckets.get(tenant)
+        if b is None:
+            if len(self._buckets) >= _MAX_BUCKETS:
+                for k in [k for k, v in self._buckets.items()
+                          if v[0] >= self.tenant_burst and k not in self._q]:
+                    del self._buckets[k]
+            b = self._buckets[tenant] = [self.tenant_burst, now]
+        b[0] = min(self.tenant_burst,
+                   b[0] + (now - b[1]) * self.tenant_rps)
+        b[1] = now
+        if b[0] >= 1.0:
+            b[0] -= 1.0
+            return None
+        return (1.0 - b[0]) / self.tenant_rps * 1e3
+
+    # ------------------------------------------------------------------
+    # pop side: deficit round-robin across tenants
+
     def pop(self, timeout: float | None = None
             ) -> tuple[Request | None, list[Request]]:
-        """``(head, expired)``: the first still-live request (None on
-        timeout / empty-and-closed), plus any requests that expired while
-        queued — the caller owes each of those a ``DeadlineExceeded``
-        response."""
+        """``(head, expired)``: the next still-live request in DRR order
+        (None on timeout / empty-and-closed), plus any requests that
+        expired while queued — the caller owes each of those a
+        ``DeadlineExceeded`` response."""
         expired: list[Request] = []
         with self._cv:
             while True:
-                while self._dq:
-                    req = self._dq.popleft()
-                    if req.expired():
-                        expired.append(req)
-                        continue
+                req = self._pop_drr(expired)
+                if req is not None:
                     self._gauge()
                     return req, expired
                 # gauge only on actual depth TRANSITIONS: an idle daemon's
@@ -109,31 +194,69 @@ class AdmissionQueue:
                         self._gauge()
                     return None, expired
 
+    def _pop_drr(self, expired: list[Request]) -> Request | None:
+        """One DRR scan (lock held): serve the first tenant whose deficit
+        covers a request, drain expired heads, retire emptied tenants."""
+        for _ in range(len(self._ring)):
+            if not self._ring:
+                return None
+            t = self._ring[0]
+            dq = self._q.get(t)
+            while dq and dq[0].expired():
+                expired.append(dq.popleft())
+                self._count -= 1
+            if not dq:
+                self._ring.popleft()
+                self._q.pop(t, None)
+                self._deficit.pop(t, None)
+                continue
+            self._deficit[t] = self._deficit.get(t, 0.0) + _QUANTUM
+            if self._deficit[t] >= _COST:
+                self._deficit[t] -= _COST
+                req = dq.popleft()
+                self._count -= 1
+                self._ring.rotate(-1)     # the NEXT tenant leads next pop
+                return req
+            self._ring.rotate(-1)
+        return None
+
+    # ------------------------------------------------------------------
+    # batcher surface (key-matched coalescing across all tenants)
+
     def take_matching(self, key: tuple,
                       limit: int) -> tuple[list[Request], list[Request]]:
         """``(matches, expired)``: remove up to ``limit`` queued requests
-        whose batch key equals ``key`` (scanning the whole queue:
-        coalescing may jump the FIFO — that is the point of batching).
-        Expired MATCHING requests are drained too (second list; the
-        caller owes each a ``DeadlineExceeded``) — leaving them queued
-        would make the batcher's linger loop spin on a queue that looks
-        non-empty but never yields a member."""
+        whose batch key equals ``key`` (scanning every tenant's deque:
+        coalescing may jump both the FIFO and the DRR ring — a shared
+        dispatch serves everyone in it at once, so it can only HELP the
+        tenants it skips ahead of).  Expired MATCHING requests are
+        drained too (second list; the caller owes each a
+        ``DeadlineExceeded``) — leaving them queued would make the
+        batcher's linger loop spin on a queue that looks non-empty but
+        never yields a member."""
         if limit <= 0:
             return [], []
         out: list[Request] = []
         expired: list[Request] = []
         with self._cv:
-            kept: collections.deque[Request] = collections.deque()
-            while self._dq and len(out) < limit:
-                req = self._dq.popleft()
-                if req.batch_key() != key:
-                    kept.append(req)
-                elif req.expired():
-                    expired.append(req)
-                else:
-                    out.append(req)
-            kept.extend(self._dq)
-            self._dq = kept
+            for t in list(self._ring):
+                dq = self._q.get(t)
+                if not dq:
+                    continue
+                kept: collections.deque[Request] = collections.deque()
+                while dq and len(out) < limit:
+                    req = dq.popleft()
+                    if req.batch_key() != key:
+                        kept.append(req)
+                    elif req.expired():
+                        expired.append(req)
+                    else:
+                        out.append(req)
+                kept.extend(dq)
+                self._q[t] = kept
+                if len(out) >= limit:
+                    break
+            self._count -= len(out) + len(expired)
             if out or expired:
                 self._gauge()
         return out, expired
@@ -143,14 +266,15 @@ class AdmissionQueue:
         The batcher's adaptive delay uses this to sleep exactly until a
         coalescing candidate COULD exist instead of polling."""
         with self._cv:
-            if self._dq:
+            if self._count:
                 return True
             self._cv.wait(timeout)
-            return bool(self._dq)
+            return bool(self._count)
 
     def has_other_work(self, key: tuple) -> bool:
         """Whether a NON-matching request is queued — the adaptive batch
         window closes early when holding the dispatch would add latency
         to somebody else's unrelated work."""
         with self._cv:
-            return any(r.batch_key() != key for r in self._dq)
+            return any(r.batch_key() != key
+                       for dq in self._q.values() for r in dq)

@@ -45,6 +45,7 @@ import functools
 import itertools
 import json
 import socket
+import threading
 import time
 
 from pluss.config import SHARE_CAP, SamplerConfig
@@ -141,6 +142,37 @@ class Request:
     #: response writer installed by the connection handler:
     #: ``reply(dict)`` — must be safe to call from the device loop
     reply: object = None
+    #: fairness id (``obj["tenant"]``): the DRR queue round-robins across
+    #: these and the token bucket meters per value; "" is the shared
+    #: anonymous tenant
+    tenant: str = ""
+    #: True once the request sits in the serve journal as ``open`` — the
+    #: first claimed reply marks it ``done``
+    journaled: bool = False
+    #: claim-once guard: with a watchdog, a hard-bounded drain, and a
+    #: stale device loop all able to answer the same request, exactly ONE
+    #: of them may win (see :meth:`claim`)
+    answered: bool = False
+    _claim_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
+
+    def claim(self) -> bool:
+        """Test-and-set the once-only right to answer this request.
+        Returns True exactly once; late repliers (a stale abandoned
+        device loop, a deadline racing the watchdog) get False and must
+        stay silent."""
+        with self._claim_lock:
+            if self.answered:
+                return False
+            self.answered = True
+            return True
+
+    def is_claimed(self) -> bool:
+        """Non-consuming peek at the claim flag: lets a dispatch path
+        skip members somebody (the watchdog, a forced drain) already
+        answered, WITHOUT eating their claim."""
+        with self._claim_lock:
+            return self.answered
 
     def remaining_s(self) -> float | None:
         if self.deadline is None:
@@ -299,9 +331,15 @@ def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
         raise InvalidRequest(
             f"request {rid!r}: deadline_ms must be a positive number",
             site="serve.parse")
+    tenant = obj.get("tenant", "")
+    if not isinstance(tenant, str) or len(tenant) > 128:
+        raise InvalidRequest(
+            f"request {rid!r}: tenant must be a string of <= 128 chars",
+            site="serve.parse")
     now = time.monotonic()
     req = Request(
         id=rid,
+        tenant=tenant,
         kind="sleep" if selectors == ["sleep"] else
              ("trace" if selectors == ["trace"] else "spec"),
         origin=selectors[0] if selectors[0] in ("trace", "sleep", "source")
@@ -445,6 +483,11 @@ def error_response(rid: str | None, err: BaseException) -> dict:
         diags = getattr(err, "diagnostics", ())
         if diags:
             e["diagnostics"] = list(diags)
+        # sheds name their suggested back-off so clients don't have to
+        # guess (token-bucket refill, the breaker's next probe slot, ...)
+        retry_after = getattr(err, "retry_after_ms", None)
+        if retry_after is not None:
+            e["retry_after_ms"] = int(retry_after)
     else:
         e = {"type": "InternalError",
              "message": f"{type(err).__name__}: {err}",

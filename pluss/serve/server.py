@@ -30,7 +30,35 @@ the resilience ladder inside its own batch; other in-flight requests see
 nothing (the soak harness pins batched results bit-identical to solo
 runs, degraded neighbors included).  Draining (``shutdown()``, SIGTERM,
 or a ``{"op": "shutdown"}`` control line) stops admission, finishes the
-queue, answers everything, flushes telemetry, and exits cleanly.
+queue, answers everything, flushes telemetry, and exits cleanly — with
+``drain_timeout_s`` as a HARD bound: past it, everything still queued or
+stuck in flight is answered typed retryable and the daemon exits 0.
+
+Fleet hardening (r14) rides four more layers:
+
+- **crash-safe request journal** (``--journal-dir`` /
+  ``PLUSS_SERVE_JOURNAL``): every accepted non-sleep request is appended
+  ``open`` before it can dispatch and marked ``done`` on the first
+  reply; a restarted daemon replays the still-open entries through
+  normal admission and parks the answers for reconnecting clients
+  (``{"op": "result", "id": rid}``), bit-identical to a clean run;
+- **hung-dispatch watchdog** (``PLUSS_SERVE_DISPATCH_TIMEOUT_S``): a
+  monitor thread abandons a wedged device dispatch to a FRESH device
+  loop (generation-tagged; the stale loop exits on its own and its late
+  replies lose the per-request claim race), answering the members typed
+  retryable;
+- **device circuit breaker**
+  (:class:`~pluss.resilience.breaker.CircuitBreaker`): classified
+  device failures open it; while open, spec requests brown out under
+  the host CPU device (bit-identical, stamped ``cpu_brownout``, never
+  process-pinned) and trace requests shed typed ``Overloaded`` carrying
+  the next probe slot as ``retry_after_ms``;
+- **per-tenant fairness** (:class:`~pluss.serve.admission`): DRR pops +
+  token-bucket rate limits keyed on the request's ``tenant`` field.
+
+Supervisors poll ``{"op": "health"}`` (always answers) and
+``{"op": "ready"}`` (ready = warmed AND breaker closed AND queue below
+the high-water mark AND not draining).
 """
 
 from __future__ import annotations
@@ -43,20 +71,34 @@ import threading
 import time
 
 from pluss import obs
-from pluss.resilience.errors import DeadlineExceeded, classify
+from pluss.resilience.breaker import CircuitBreaker
+from pluss.resilience.errors import (
+    CompileError,
+    DeadlineExceeded,
+    Overloaded,
+    ResourceExhausted,
+    classify,
+)
 from pluss.resilience.ladder import SERVE_LADDER, Retry
 from pluss.serve.admission import AdmissionQueue
 from pluss.serve.batcher import Batcher
+from pluss.serve.journal import RequestJournal
 from pluss.serve.protocol import (
     Request,
     error_response,
     parse_request,
     result_payload,
 )
+from pluss.utils.envknob import env_float, env_int
 
 #: trace-replay rung subset for serving: like TRACE_LADDER minus the
 #: process-pinning ``cpu_fallback`` (same reasoning as SERVE_LADDER)
 SERVE_TRACE_LADDER: tuple[str, ...] = ("serial_feed", "shrink_window")
+
+#: ``{"op": "ready"}`` reports not-ready once the queue passes this
+#: fraction of ``max_queue`` — a supervisor should stop routing new
+#: traffic here BEFORE requests start shedding, not after
+READY_HIGHWATER = 0.8
 
 
 @dataclasses.dataclass
@@ -74,6 +116,38 @@ class ServeConfig:
     #: ``name[:n[:threads[:chunk]]]`` entries, or ``all`` for every
     #: registry model at the default warm size — see :func:`_warm_objs`
     warm: str | None = None
+    # -- fleet hardening (r14).  The None-valued knobs resolve through
+    # envknob warn-and-default at Server construction, so a fleet can be
+    # tuned per-host without new CLI plumbing:
+    #: crash-safe request journal directory (``--journal-dir`` /
+    #: ``PLUSS_SERVE_JOURNAL``); None disables journaling
+    journal_dir: str | None = None
+    #: watchdog bound on one device dispatch, seconds
+    #: (``PLUSS_SERVE_DISPATCH_TIMEOUT_S``, default 120; 0 disables)
+    dispatch_timeout_s: float | None = None
+    #: breaker: failures-in-window that open it
+    #: (``PLUSS_SERVE_BREAKER_THRESHOLD``, default 5)
+    breaker_threshold: int | None = None
+    #: breaker failure-counting window, seconds
+    #: (``PLUSS_SERVE_BREAKER_WINDOW_S``, default 30)
+    breaker_window_s: float | None = None
+    #: breaker base open->half-open cooldown, seconds
+    #: (``PLUSS_SERVE_BREAKER_COOLDOWN_S``, default 5)
+    breaker_cooldown_s: float | None = None
+    #: per-tenant token-bucket refill rate, requests/second
+    #: (``PLUSS_SERVE_TENANT_RPS``, default 0 = rate limiting off)
+    tenant_rps: float | None = None
+    #: per-tenant burst (``PLUSS_SERVE_TENANT_BURST``, default 2x rps)
+    tenant_burst: float | None = None
+    #: concurrent-connection cap (``PLUSS_SERVE_MAX_CONNS``, default
+    #: 256); excess connections get one typed Overloaded line and close
+    max_conns: int | None = None
+    #: per-connection idle timeout, seconds
+    #: (``PLUSS_SERVE_CONN_IDLE_S``, default 300; 0 disables)
+    conn_idle_s: float | None = None
+    #: HARD drain bound (``--drain-timeout-s``): past it, still-pending
+    #: requests are answered typed retryable and shutdown completes
+    drain_timeout_s: float = 60.0
 
 
 #: ``--warm`` entry defaults (small enough to compile fast, large enough
@@ -134,8 +208,40 @@ class Server:
             raise ValueError("pass exactly one of socket_path / port")
         self.socket_path = socket_path
         self.host, self.port = host, port
-        self.config = config or ServeConfig()
-        self.queue = AdmissionQueue(self.config.max_queue)
+        self.config = c = config or ServeConfig()
+        # hardening knobs: explicit config wins, else envknob
+        # warn-and-default
+        self._dispatch_timeout_s = c.dispatch_timeout_s \
+            if c.dispatch_timeout_s is not None \
+            else env_float("PLUSS_SERVE_DISPATCH_TIMEOUT_S", 120.0,
+                           minimum=0.0)
+        self._max_conns = c.max_conns if c.max_conns is not None \
+            else env_int("PLUSS_SERVE_MAX_CONNS", 256)
+        self._conn_idle_s = c.conn_idle_s if c.conn_idle_s is not None \
+            else env_float("PLUSS_SERVE_CONN_IDLE_S", 300.0, minimum=0.0)
+        tenant_rps = c.tenant_rps if c.tenant_rps is not None \
+            else env_float("PLUSS_SERVE_TENANT_RPS", 0.0, minimum=0.0)
+        tenant_burst = c.tenant_burst if c.tenant_burst is not None \
+            else env_float("PLUSS_SERVE_TENANT_BURST", 0.0, minimum=0.0)
+        self.queue = AdmissionQueue(c.max_queue, tenant_rps=tenant_rps,
+                                    tenant_burst=tenant_burst or None)
+        self.breaker = CircuitBreaker(
+            threshold=c.breaker_threshold if c.breaker_threshold is not None
+            else env_int("PLUSS_SERVE_BREAKER_THRESHOLD", 5),
+            window_s=c.breaker_window_s if c.breaker_window_s is not None
+            else env_float("PLUSS_SERVE_BREAKER_WINDOW_S", 30.0,
+                           minimum=0.1),
+            cooldown_s=c.breaker_cooldown_s
+            if c.breaker_cooldown_s is not None
+            else env_float("PLUSS_SERVE_BREAKER_COOLDOWN_S", 5.0,
+                           minimum=0.05),
+            name="serve.breaker")
+        journal_dir = c.journal_dir or os.environ.get("PLUSS_SERVE_JOURNAL")
+        self._journal = RequestJournal(
+            os.path.join(journal_dir, "serve_journal.jsonl")) \
+            if journal_dir else None
+        self._recovered: dict[str, dict] = {}   # rid -> parked response
+        self._recovered_lock = threading.Lock()
         self.batcher = Batcher(self.queue, self.config.max_batch,
                                self.config.max_delay_ms)
         self.latency = obs.LatencyReservoir()
@@ -157,6 +263,17 @@ class Server:
         # the device loop (park/collect) and _bg_compile (event set).
         self._park_lock = threading.Lock()
         self._parked: dict = {}
+        # watchdog state: device loops carry a GENERATION — abandoning a
+        # hung dispatch bumps the generation (the stale loop exits at its
+        # next top-of-loop check) and spawns a fresh loop.  _inflight is
+        # (gen, t0, batch) while a spec/trace dispatch is on the device.
+        self._gen_lock = threading.Lock()
+        self._dev_gen = 0
+        self._inflight_lock = threading.Lock()
+        self._inflight: tuple[int, float, list[Request]] | None = None
+        # readiness: set immediately when no --warm is configured, else
+        # at the end of the warm loop
+        self._warm_done = threading.Event()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -182,11 +299,25 @@ class Server:
                   max_batch=self.config.max_batch,
                   max_delay_ms=self.config.max_delay_ms)
         for name, target in (("pluss-serve-accept", self._accept_loop),
-                             ("pluss-serve-device", self._device_loop),
                              ("pluss-serve-slo", self._slo_loop)):
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
+        self._spawn_device_loop()
+        if self._dispatch_timeout_s > 0:
+            t = threading.Thread(target=self._watchdog_loop,
+                                 name="pluss-serve-watchdog", daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self._journal is not None:
+            pending = self._journal.unanswered()
+            if pending:
+                t = threading.Thread(target=self._recover_loop,
+                                     args=(pending,),
+                                     name="pluss-serve-recover",
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
         if self.config.heartbeat_dir:
             from pluss.parallel.multihost import start_heartbeat_exporter
 
@@ -199,6 +330,8 @@ class Server:
                                  name="pluss-serve-warm", daemon=True)
             t.start()
             self._threads.append(t)
+        else:
+            self._warm_done.set()   # nothing to warm: born ready
 
     def _warm_loop(self) -> None:
         """Background warmup: precompile each ``--warm`` entry's plan
@@ -207,6 +340,15 @@ class Server:
         registry dedupes against any request that races a warm entry.
         Failures are counted + evented, never fatal — a bad entry leaves
         that model cold, nothing else."""
+        try:
+            self._warm_loop_inner()
+        finally:
+            # ready-gating only: a failed warmup still ends the warming
+            # phase (the failures are counted + evented), it does not
+            # wedge ``{"op": "ready"}`` at not-ready forever
+            self._warm_done.set()
+
+    def _warm_loop_inner(self) -> None:
         from pluss import engine
 
         warmed = 0
@@ -241,6 +383,51 @@ class Server:
                           error=f"{type(e).__name__}: {e}")
         obs.event("serve.warm_done", warmed=warmed)
 
+    def _recover_loop(self, pending: list[dict]) -> None:
+        """Replay journaled-unanswered requests through NORMAL admission.
+
+        Each recovered request's reply PARKS its response keyed by rid —
+        a reconnecting client collects it with
+        ``{"op": "result", "id": rid}`` — and the first claimed reply
+        marks the journal entry done, exactly like a live request.
+        Entries whose wall-clock deadline died with the old process are
+        answered typed ``DeadlineExceeded`` without touching the device
+        (the no-re-execution premise: never burn capacity on an answer
+        nobody can still be waiting for)."""
+        obs.event("serve.recover_start", entries=len(pending))
+        for rec in pending:
+            if self._stopping.is_set():
+                return
+            rid = rec.get("rid")
+            dle = rec.get("deadline_epoch")
+
+            def park(doc: dict, rid=rid) -> None:
+                with self._recovered_lock:
+                    self._recovered[rid] = doc
+                obs.counter_add("serve.journal.recovered")
+
+            if dle is not None and time.time() >= dle:
+                obs.counter_add("serve.deadline_exceeded")
+                obs.counter_add("serve.journal.expired")
+                self._journal.complete(rid)
+                park(error_response(rid, DeadlineExceeded(
+                    "deadline passed before the daemon was restarted",
+                    site="serve.recover")))
+                continue
+            try:
+                req = parse_request(rec.get("obj"),
+                                    self.config.default_deadline_ms)
+                if dle is not None:
+                    # rebase the surviving wall-clock budget onto this
+                    # process's monotonic clock
+                    req.deadline = time.monotonic() + (dle - time.time())
+                req.reply = park
+                req.journaled = True   # already `open` in the journal
+                self.queue.submit(req)
+            except Exception as e:  # noqa: BLE001 — typed park, no escape
+                self._journal.complete(rid)
+                park(error_response(rid, classify(e, site="serve.recover")))
+
     @property
     def address(self) -> str:
         return self.socket_path or f"{self.host}:{self.port}"
@@ -258,9 +445,18 @@ class Server:
         self._stop_requested.wait()
         self.shutdown()
 
-    def shutdown(self, drain_timeout_s: float = 60.0) -> None:
+    def shutdown(self, drain_timeout_s: float | None = None) -> None:
         """Drain-and-stop: close admission, finish every queued request,
-        answer everything, flush telemetry.  Idempotent."""
+        answer everything, flush telemetry.  Idempotent.
+
+        ``drain_timeout_s`` (default: the config's) is a HARD bound: a
+        drain that cannot finish — a dispatch wedged in XLA, a compile
+        that never returns — answers everything still queued, parked, or
+        in flight with a typed retryable error and completes anyway.
+        Exit 0, not a hang: the supervisor restarting us (with
+        ``--recover``) is the path that actually serves those clients."""
+        if drain_timeout_s is None:
+            drain_timeout_s = self.config.drain_timeout_s
         with self._shutdown_lock:   # atomic test-and-set: the control-
             # line path and serve_forever's signal path can race here
             already = self._shutdown_started
@@ -281,7 +477,8 @@ class Server:
                 pass
         if not self._threads:   # never started: nothing will drain
             self._drained.set()
-        self._drained.wait(drain_timeout_s)
+        if not self._drained.wait(drain_timeout_s):
+            self._force_drain()
         if self._hb_stop is not None:
             self._hb_stop()
         self._publish_slo(force=True)
@@ -304,6 +501,37 @@ class Server:
             except OSError:
                 pass
 
+    def _force_drain(self) -> None:
+        """The drain hard bound fired: answer everything still queued,
+        parked, or wedged in flight with a typed retryable error and
+        declare the drain done.  The per-request claim guard makes this
+        safe against the stuck dispatch eventually completing — whoever
+        claims first answers, the other goes silent."""
+        obs.counter_add("serve.drain_forced")
+        obs.event("serve.drain_forced", queue_depth=len(self.queue))
+        err = Overloaded(
+            "server shut down before this request was served; retry",
+            site="serve.drain", retry_after_ms=1000)
+        while True:   # still-queued requests (the queue is closed)
+            req, expired = self.queue.pop(timeout=0)
+            for r in expired:
+                self._respond_deadline(r)
+            if req is None:
+                break
+            self._respond_err(req.reply, req.id, err, req=req)
+        with self._park_lock:   # batches parked behind a compile
+            parked = list(self._parked.values())
+            self._parked.clear()
+        for reqs, _done in parked:
+            for r in reqs:
+                self._respond_err(r.reply, r.id, err, req=r)
+        with self._inflight_lock:   # the stuck in-flight batch itself
+            inflight, self._inflight = self._inflight, None
+        if inflight is not None:
+            for r in inflight[2]:
+                self._respond_err(r.reply, r.id, err, req=r)
+        self._drained.set()
+
     # -- listener / connections ---------------------------------------------
 
     def _accept_loop(self) -> None:
@@ -319,6 +547,31 @@ class Server:
                 obs.counter_add("serve.accept_errors")
                 time.sleep(0.05)
                 continue
+            with self._conn_lock:
+                n_conns = len(self._conns)
+            if self._max_conns and n_conns >= self._max_conns:
+                # typed shed AT ACCEPT: one Overloaded line, then close —
+                # a reader thread per unbounded connection is exactly the
+                # resource a connection flood exhausts
+                obs.counter_add("serve.conn_shed")
+                try:
+                    conn.sendall(json.dumps(error_response(
+                        None, Overloaded(
+                            f"connection limit reached "
+                            f"({self._max_conns}); back off and retry",
+                            site="serve.accept", retry_after_ms=100)))
+                        .encode() + b"\n")
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            if self._conn_idle_s > 0:
+                # slowloris guard: a connection idle past the bound gets
+                # its reader thread reclaimed (see _conn_loop)
+                conn.settimeout(self._conn_idle_s)
             with self._conn_lock:
                 self._conns.append(conn)
             t = threading.Thread(target=self._conn_loop, args=(conn,),
@@ -342,6 +595,11 @@ class Server:
                 if not line.strip():
                     continue
                 self._handle_line(line, reply)
+        except TimeoutError:
+            # socket.timeout subclasses OSError, so it MUST be caught
+            # before the bare-OSError fallthrough or idle closes would
+            # be silently indistinguishable from client disconnects
+            obs.counter_add("serve.conn_idle_closed")
         except OSError:
             pass
         finally:
@@ -382,19 +640,72 @@ class Server:
         # show the ingestion surface it arrived through
         obs.counter_add(f"serve.requests.{req.origin or req.kind}")
         req.reply = reply
+        self._journal_append(req, obj)
         try:
             self.queue.submit(req)
         except Exception as e:  # noqa: BLE001 — Overloaded et al, typed
             self._respond_err(reply, req.id, classify(
-                e, site="serve.admission"))
+                e, site="serve.admission"), req=req)
+
+    def _journal_append(self, req: Request, obj: dict) -> None:
+        """Journal an admitted request BEFORE it queues: the record must
+        exist before any crash that could lose the in-memory queue.
+        Sleeps are never journaled (a synthetic hold is not work a
+        restarted daemon owes anybody)."""
+        if self._journal is None or req.kind == "sleep":
+            return
+        rem = req.remaining_s()
+        try:
+            # wall-clock deadline: monotonic instants do not survive a
+            # restart, but "N seconds from admission" does
+            self._journal.append(
+                req.id, {**obj, "id": req.id}, tenant=req.tenant,
+                deadline_epoch=time.time() + rem if rem is not None
+                else None)
+            req.journaled = True
+        except OSError:
+            # a full/broken journal disk must not take serving down with
+            # it — the request just loses crash coverage
+            obs.counter_add("serve.journal.append_fail")
 
     def _handle_control(self, op: str, obj: dict, reply) -> None:
         if op == "ping":
             reply({"id": obj.get("id"), "ok": True, "op": "ping"})
         elif op == "stats":
+            from pluss import engine
+
             reply({"id": obj.get("id"), "ok": True, "op": "stats",
                    "counters": obs.counters(), "gauges": obs.gauges(),
-                   "queue_depth": len(self.queue)})
+                   "queue_depth": len(self.queue),
+                   # zero-recompute witness for the crash/recover soak:
+                   # completed journal entries must not move this
+                   "device_dispatches": int(engine.DEVICE_DISPATCHES)})
+        elif op == "health":
+            with self._conn_lock:
+                n_conns = len(self._conns)
+            reply({"id": obj.get("id"), "ok": True, "op": "health",
+                   "breaker": self.breaker.state,
+                   "queue_depth": len(self.queue),
+                   "conns": n_conns,
+                   "warmed": self._warm_done.is_set(),
+                   "draining": self._stopping.is_set()})
+        elif op == "ready":
+            reasons = self._not_ready_reasons()
+            reply({"id": obj.get("id"), "ok": True, "op": "ready",
+                   "ready": not reasons, "reasons": reasons})
+        elif op == "result":
+            # reconnect surface for recovered requests: a client that
+            # crashed with the daemon re-asks by rid instead of re-paying
+            rid = obj.get("id")
+            rid = None if rid is None else str(rid)
+            with self._recovered_lock:
+                doc = self._recovered.pop(rid, None)
+            if doc is not None:
+                reply(doc)
+            else:
+                reply({"id": rid, "ok": False, "op": "result",
+                       "pending": bool(self._journal is not None and rid
+                                       and self._journal.is_open(rid))})
         elif op == "shutdown":
             # ack first, THEN signal: the drain closes this connection
             reply({"id": obj.get("id"), "ok": True, "op": "shutdown",
@@ -412,11 +723,45 @@ class Server:
             reply(error_response(obj.get("id"), InvalidRequest(
                 f"unknown op {op!r}", site="serve.parse")))
 
+    def _not_ready_reasons(self) -> list[str]:
+        """Why a load balancer should NOT route here right now.  Empty
+        means ready: warmed, breaker closed, queue below high-water, not
+        draining."""
+        reasons: list[str] = []
+        if not self._warm_done.is_set():
+            reasons.append("warmup in progress")
+        state = self.breaker.state
+        if state != "closed":
+            reasons.append(f"breaker {state}")
+        highwater = max(1, int(self.config.max_queue * READY_HIGHWATER))
+        depth = len(self.queue)
+        if depth >= highwater:
+            reasons.append(
+                f"queue depth {depth} >= high-water {highwater}")
+        if self._stopping.is_set():
+            reasons.append("draining")
+        return reasons
+
     # -- device loop --------------------------------------------------------
 
-    def _device_loop(self) -> None:
+    def _spawn_device_loop(self) -> None:
+        """Start a fresh device loop under a NEW generation.  Bumping the
+        generation first stales any previous loop: a hung dispatch that
+        eventually returns finds ``gen != self._dev_gen`` and exits
+        instead of racing the replacement for the queue."""
+        with self._gen_lock:
+            self._dev_gen += 1
+            gen = self._dev_gen
+        t = threading.Thread(target=self._device_loop, args=(gen,),
+                             name=f"pluss-serve-device-{gen}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _device_loop(self, gen: int) -> None:
         while True:
-            self._run_ready_parked()
+            if gen != self._dev_gen:
+                return   # abandoned by the watchdog: a fresh loop owns the queue
+            self._run_ready_parked(gen=gen)
             batch, expired = self.batcher.next_batch(timeout=0.25)
             for req in expired:
                 self._respond_deadline(req)
@@ -425,14 +770,14 @@ class Server:
                     if self._parked:
                         # drain must answer parked members too: wait out
                         # their compiles and execute before declaring done
-                        self._run_ready_parked(wait=True)
+                        self._run_ready_parked(wait=True, gen=gen)
                         continue
                     self._drained.set()
                     return
                 continue
             if self._maybe_park(batch):
                 continue
-            self._execute(batch)
+            self._execute(batch, gen)
 
     def _maybe_park(self, batch: list[Request]) -> bool:
         """Keep the device loop draining while a cold key compiles.
@@ -482,7 +827,8 @@ class Server:
         finally:
             done.set()
 
-    def _run_ready_parked(self, wait: bool = False) -> None:
+    def _run_ready_parked(self, wait: bool = False,
+                          gen: int | None = None) -> None:
         with self._park_lock:
             items = list(self._parked.items())
         for key, (reqs, done) in items:
@@ -492,9 +838,70 @@ class Server:
                 continue
             with self._park_lock:
                 self._parked.pop(key, None)
-            self._execute(reqs)
+            self._execute(reqs, gen)
 
-    def _execute(self, batch: list[Request]) -> None:
+    # -- watchdog -----------------------------------------------------------
+
+    def _set_inflight(self, gen: int | None, batch: list[Request]) -> None:
+        if gen is None:
+            return
+        with self._inflight_lock:
+            self._inflight = (gen, time.monotonic(), batch)
+
+    def _clear_inflight(self, gen: int | None) -> None:
+        if gen is None:
+            return
+        with self._inflight_lock:
+            if self._inflight is not None and self._inflight[0] == gen:
+                self._inflight = None
+
+    def _watchdog_loop(self) -> None:
+        """Bound every device dispatch by ``_dispatch_timeout_s``: a hung
+        dispatch (wedged compile, dead device, injected ``hang`` fault)
+        is abandoned — its batch answered typed-retryable, its loop
+        staled, a fresh loop spawned — instead of wedging serving until
+        an operator notices."""
+        timeout = self._dispatch_timeout_s
+        poll = max(0.02, min(0.25, timeout / 4.0))
+        while not self._stopping.wait(poll):
+            with self._inflight_lock:
+                inf = self._inflight
+            if inf is None:
+                continue
+            gen, t0, batch = inf
+            age = time.monotonic() - t0
+            if age >= timeout:
+                self._abandon(gen, batch, age)
+
+    def _abandon(self, gen: int, batch: list[Request], age: float) -> None:
+        with self._inflight_lock:
+            if self._inflight is None or self._inflight[0] != gen:
+                return   # the dispatch finished while we decided
+            self._inflight = None
+        # stale the hung loop BEFORE answering or respawning: if its
+        # dispatch ever returns, the generation check makes it exit
+        # without popping another batch
+        with self._gen_lock:
+            if self._dev_gen == gen:
+                self._dev_gen += 1
+        obs.counter_add("serve.watchdog.abandoned")
+        obs.counter_add("serve.watchdog.abandoned_requests", len(batch))
+        obs.event("serve.watchdog_abandon", age_s=round(age, 3),
+                  batch=len(batch))
+        # a hang is evidence against the device, same as a classified
+        # dispatch failure
+        self.breaker.record_failure()
+        err = Overloaded(
+            f"dispatch abandoned by the watchdog after {age:.1f}s; retry",
+            site="serve.watchdog", retry_after_ms=1000)
+        for req in batch:
+            self._respond_err(req.reply, req.id, err, req=req)
+        self._spawn_device_loop()
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _execute(self, batch: list[Request],
+                 gen: int | None = None) -> None:
         # members can expire between batching and dispatch
         live = []
         for req in batch:
@@ -505,6 +912,7 @@ class Server:
         if not live:
             return
         lead = live[0]
+        brownout = False
         with obs.span("serve.batch", kind=lead.kind, size=len(live)):
             try:
                 if lead.kind == "sleep":
@@ -512,20 +920,66 @@ class Server:
                     self._respond_ok(lead, {"slept_ms": lead.sleep_ms},
                                      len(live))
                     return
-                if lead.kind == "spec":
-                    self._execute_spec(live)
-                else:
-                    self._execute_trace(live)
+                if not self.breaker.allow():
+                    brownout = True
+                    self._brownout(live)
+                    return
+                self._set_inflight(gen, live)
+                try:
+                    from pluss.resilience import faults
+
+                    faults.check("serve.dispatch")
+                    # success is recorded via on_success BEFORE replies
+                    # fan out: a client reading {"op": "health"} right
+                    # after its probe answer must see the closed state
+                    if lead.kind == "spec":
+                        self._execute_spec(
+                            live, on_success=self.breaker.record_success)
+                    else:
+                        self._execute_trace(
+                            live, on_success=self.breaker.record_success)
+                finally:
+                    self._clear_inflight(gen)
             except BaseException as e:  # noqa: BLE001 — typed fan-out
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     raise
                 err = classify(e, site=f"serve.{lead.kind}")
+                if not brownout and isinstance(
+                        err, (ResourceExhausted, CompileError)):
+                    # only DEVICE evidence feeds the breaker: client
+                    # errors and deadlines say nothing about the device
+                    self.breaker.record_failure()
                 if isinstance(err, DeadlineExceeded):
                     # a deadline blown INSIDE the ladder must land in the
                     # same SLO counter as the queue/demux expiry paths
                     obs.counter_add("serve.deadline_exceeded", len(live))
                 for req in live:
-                    self._respond_err(req.reply, req.id, err)
+                    self._respond_err(req.reply, req.id, err, req=req)
+
+    def _brownout(self, live: list[Request]) -> None:
+        """Open-breaker service: spec batches run the CPU brown-out rung
+        (slower, stamped ``cpu_brownout``, bit-identical — the engine is
+        deterministic across backends); trace replays are shed typed
+        (their value IS device-rate replay; a CPU replay would occupy the
+        loop for longer than any client deadline)."""
+        lead = live[0]
+        retry_ms = int(self.breaker.retry_after_s() * 1e3) + 1
+        if lead.kind != "spec":
+            obs.counter_add("serve.breaker.shed", len(live))
+            err = Overloaded(
+                "device circuit breaker open; trace replay shed",
+                site="serve.breaker", retry_after_ms=retry_ms)
+            for req in live:
+                self._respond_err(req.reply, req.id, err, req=req)
+            return
+        obs.counter_add("serve.breaker.brownout", len(live))
+        try:
+            import jax
+
+            device = jax.devices("cpu")[0]
+        except Exception:  # noqa: BLE001 — no cpu backend: run as-is
+            device = None
+        self._execute_spec(live, device=device, stamp=("cpu_brownout",))
 
     @staticmethod
     def _batch_deadline_s(batch: list[Request]) -> float | None:
@@ -538,16 +992,40 @@ class Server:
             return None
         return max(rems)
 
-    def _execute_spec(self, batch: list[Request]) -> None:
+    def _execute_spec(self, batch: list[Request], device=None,
+                      stamp: tuple[str, ...] = (),
+                      on_success=None) -> None:
         from pluss import cri
         from pluss.resilience.ladder import run_resilient
 
+        # members the watchdog or a forced drain already answered must
+        # not burn a dispatch: an abandoned thread waking from a wedged
+        # hang would otherwise run the engine for nobody — and eat a
+        # fault plan or a breaker budget some LIVE request owns
+        batch = [r for r in batch if not r.is_claimed()]
+        if not batch:
+            return
         lead = batch[0]
-        res = run_resilient(
-            lead.spec, lead.cfg, lead.share_cap,
-            window_accesses=lead.window, rungs=SERVE_LADDER,
-            retry=Retry(backoff_s=0.01),
-            deadline_s=self._batch_deadline_s(batch))
+        # brown-out runs under jax.default_device — scoped to this
+        # dispatch, never process-pinning (force_cpu is banned in serve:
+        # it would demote every LATER dispatch too)
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+        if device is not None:
+            import jax
+
+            ctx = jax.default_device(device)
+        with ctx:
+            res = run_resilient(
+                lead.spec, lead.cfg, lead.share_cap,
+                window_accesses=lead.window, rungs=SERVE_LADDER,
+                retry=Retry(backoff_s=0.01),
+                deadline_s=self._batch_deadline_s(batch))
+        if stamp:
+            res.degradations = tuple(res.degradations) + tuple(stamp)
+        if on_success is not None:
+            on_success()
         k = len(batch)
         for req in batch:
             if req.expired():
@@ -566,11 +1044,15 @@ class Server:
                 payload["degradations"] = list(view.degradations)
             self._respond_ok(req, payload, k)
 
-    def _execute_trace(self, batch: list[Request]) -> None:
+    def _execute_trace(self, batch: list[Request],
+                       on_success=None) -> None:
         from pluss import residency
         from pluss import trace as trace_mod
         from pluss.resilience.ladder import replay_file_resilient
 
+        batch = [r for r in batch if not r.is_claimed()]
+        if not batch:
+            return
         lead = batch[0]
         # Ride the residency store: a repeat trace replays from HBM with
         # zero feed bytes.  Admission priced the staging (hbm_bytes, r13)
@@ -583,6 +1065,8 @@ class Server:
             window=lead.window or trace_mod.TRACE_WINDOW,
             resident_cache=resident,
             rungs=SERVE_TRACE_LADDER, retry=Retry(backoff_s=0.01))
+        if on_success is not None:
+            on_success()
         k = len(batch)
         for req in batch:
             if req.expired():
@@ -607,7 +1091,21 @@ class Server:
         if n % 32 == 0:
             self._publish_slo()
 
+    def _claimed(self, req: Request) -> bool:
+        """Claim the ONE answer a request gets.  False means somebody
+        (the watchdog, a forced drain, a racing demux path) answered
+        first — the caller must not reply again.  The first claim also
+        marks the journal entry done: from here a crash owes the client
+        nothing."""
+        if not req.claim():
+            return False
+        if self._journal is not None and req.journaled:
+            self._journal.complete(req.id)
+        return True
+
     def _respond_ok(self, req: Request, payload: dict, k: int) -> None:
+        if not self._claimed(req):
+            return
         ms = (time.monotonic() - req.t_admit) * 1e3
         doc = {"id": req.id, "ok": True, **payload,
                "batched": k, "latency_ms": round(ms, 3)}
@@ -617,16 +1115,23 @@ class Server:
         self._finish(req, ms)
         req.reply(doc)
 
-    def _respond_err(self, reply, rid, err) -> None:
+    def _respond_err(self, reply, rid, err,
+                     req: Request | None = None) -> None:
+        if req is not None and not self._claimed(req):
+            return
         obs.counter_add("serve.errors")
         self._finish(None, None)
         reply(error_response(rid, err))
 
     def _respond_deadline(self, req: Request) -> None:
+        if not self._claimed(req):
+            return
         obs.counter_add("serve.deadline_exceeded")
-        self._respond_err(req.reply, req.id, DeadlineExceeded(
+        obs.counter_add("serve.errors")
+        self._finish(None, None)
+        req.reply(error_response(req.id, DeadlineExceeded(
             "deadline passed before the result was produced",
-            site="serve.deadline"))
+            site="serve.deadline")))
 
     def _publish_slo(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -641,6 +1146,11 @@ class Server:
         if p99 is not None:
             obs.gauge_set("serve.p99_ms", round(p99, 3))
         obs.gauge_set("serve.queue_depth", float(len(self.queue)))
+        with self._inflight_lock:
+            inf = self._inflight
+        if inf is not None:
+            obs.gauge_set("serve.watchdog.dispatch_age_s",
+                          round(time.monotonic() - inf[1], 3))
         from pluss import engine
 
         obs.gauge_set("serve.compile_inflight",

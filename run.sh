@@ -103,6 +103,21 @@ JAX_PLATFORMS=cpu python soak.py --serve 20 "${PLUSS_SERVE_SEED:-20260804}" \
 python -m pluss.cli stats "$PLUSS_SERVE_LOG" --check 1>&2
 rm -f "$PLUSS_SERVE_LOG"
 
+# serve hardening smoke (tier-1, r14): health/ready verbs on a fresh
+# daemon, then two injected device dispatch failures trip the circuit
+# breaker (threshold 2) — while open, a spec request browns out on the
+# host CPU device bit-identically (stamped cpu_brownout) and a trace
+# request sheds typed Overloaded with retry_after_ms; after the cooldown
+# the half-open probe closes it and readiness returns.  Every admitted
+# request is journaled open->done.  Telemetry armed, stream
+# schema-checked — the `pluss stats` serve-hardening block reads off
+# this same file.
+PLUSS_HARD_LOG=$(mktemp /tmp/pluss_hard_XXXX.jsonl)
+JAX_PLATFORMS=cpu PLUSS_TELEMETRY="$PLUSS_HARD_LOG" \
+  python -m pluss.hardening_smoke 1>&2
+python -m pluss.cli stats "$PLUSS_HARD_LOG" --check 1>&2
+rm -f "$PLUSS_HARD_LOG"
+
 # warm-start smoke (tier-1): the persistent AOT executable cache, proven
 # across PROCESS boundaries — two fresh subprocesses run the same small
 # model sharing one plan-cache dir.  The first (cold) populates the

@@ -551,6 +551,161 @@ def serve(n_requests: int, sd: int, chaos: bool,
             print("serve soak: FAIL — daemon telemetry recorded no "
                   "residency.hit for the repeated-trace phase")
             failures += 1
+
+        # ---- crash/recover phase (r14): SIGKILL a journaled daemon
+        # mid-load, restart it with --recover, and pin the kill-recover
+        # invariant: completed journal entries are NEVER re-dispatched
+        # (witnessed by the engine's device-dispatch count), while the
+        # requests that died queued are replayed and their parked
+        # answers — collected via {"op": "result"} — are bit-identical
+        # to solo runs.
+        jdir = os.path.join(tmp, "journal")
+        sock2 = os.path.join(tmp, "serve2.sock")
+        tel3 = os.path.join(tmp, "serve3_telemetry.jsonl")
+        env2 = dict(env)
+        env2.pop("PLUSS_FAULT_PLAN", None)   # a clean crash, not chaos
+        err2 = os.path.join(tmp, "daemon2.err")
+        err3 = os.path.join(tmp, "daemon3.err")
+        here = os.path.dirname(os.path.abspath(__file__))
+        daemon2 = subprocess.Popen(
+            [sys.executable, "-m", "pluss.cli", "serve", "--socket", sock2,
+             "--cpu", "--journal-dir", jdir, "--max-batch", "1",
+             "--max-queue", "32"],
+            cwd=here, env=env2, stderr=open(err2, "w"))
+        daemon3 = None
+        try:
+            for _ in range(240):
+                if os.path.exists(sock2) or daemon2.poll() is not None:
+                    break
+                time.sleep(0.5)
+            if daemon2.poll() is not None:
+                print("serve soak: FAIL — journaled daemon died at start; "
+                      "stderr tail:")
+                print(open(err2).read()[-2000:])
+                failures += 1
+                raise RuntimeError("crash-phase daemon failed to start")
+            # two requests fully answered BEFORE the crash: their journal
+            # entries are marked done and must never re-dispatch
+            dones = [dict(pool[0], output="both", id="done-0"),
+                     dict(pool[1], output="both", id="done-1")]
+            with Client(sock2) as c:
+                for q in dones:
+                    r = c.request(q)
+                    if not r.get("ok"):
+                        print(f"serve soak: FAIL — pre-crash {q['id']} "
+                              f"got {r}")
+                        failures += 1
+            # hold the device loop, queue three requests, then SIGKILL
+            # with all three journaled open and none answered
+            holder2 = Client(sock2)
+            holder2.send({"sleep_ms": 8000})
+            time.sleep(0.2)
+            pends = [dict(pool[0], output="both", id="pend-0"),
+                     dict(pool[2], output="both", id="pend-1"),
+                     {"trace": trace_path, "output": "both",
+                      "id": "pend-2"}]
+            p2 = Client(sock2)
+            for q in pends:
+                p2.send(q)
+            jfile = os.path.join(jdir, "serve_journal.jsonl")
+            for _ in range(100):   # all three journaled open?
+                try:
+                    if '"pend-2"' in open(jfile).read():
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.1)
+            daemon2.kill()   # SIGKILL: no drain, no journal completion
+            daemon2.wait()
+            holder2.close()
+            p2.close()
+            # restart on the same socket with --recover: still-open
+            # entries replay through normal admission, answers park.
+            # Readiness is ping-until-answer — the DEAD daemon's socket
+            # file still exists, so its presence proves nothing.
+            daemon3 = subprocess.Popen(
+                [sys.executable, "-m", "pluss.cli", "serve", "--socket",
+                 sock2, "--cpu", "--recover", jdir,
+                 "--telemetry", tel3],
+                cwd=here, env=env2, stderr=open(err3, "w"))
+            up = False
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if daemon3.poll() is not None:
+                    break
+                try:
+                    with Client(sock2, timeout=5) as c:
+                        up = c.request({"op": "ping"}).get("ok", False)
+                    if up:
+                        break
+                except OSError:
+                    time.sleep(0.3)
+            if not up:
+                print("serve soak: FAIL — recovery daemon never answered "
+                      "ping; stderr tail:")
+                print(open(err3).read()[-2000:])
+                failures += 1
+                raise RuntimeError("recovery daemon failed to start")
+            recovered: dict[str, dict] = {}
+            with Client(sock2) as c:
+                for q in pends:
+                    rid = q["id"]
+                    deadline = time.monotonic() + 120
+                    while time.monotonic() < deadline:
+                        r = c.request({"op": "result", "id": rid})
+                        if r.get("op") != "result":
+                            break
+                        time.sleep(0.2)
+                    recovered[rid] = r
+                st = c.request({"op": "stats"})
+            for q in pends:
+                r = recovered[q["id"]]
+                if not r.get("ok"):
+                    print(f"serve soak: FAIL — recovered {q['id']} got "
+                          f"{r}")
+                    failures += 1
+                    continue
+                k = key_of(q)
+                if k not in solo:
+                    solo[k] = solo_payload(q)
+                if r.get("mrc") != solo[k]["mrc"] \
+                        or r.get("histogram") != solo[k]["histogram"]:
+                    print(f"serve soak: FAIL — recovered {q['id']} "
+                          "diverged from the solo run (degradations="
+                          f"{r.get('degradations')})")
+                    failures += 1
+            n_rec = st.get("counters", {}).get("serve.journal.recovered",
+                                               0)
+            if n_rec != len(pends):
+                print(f"serve soak: FAIL — serve.journal.recovered = "
+                      f"{n_rec}, want {len(pends)}")
+                failures += 1
+            # the zero-recompute witness: only the two open SPEC entries
+            # may have dispatched (the trace replay never bumps the
+            # engine's counter); a re-run of done-0/done-1 would show here
+            nd = st.get("device_dispatches", -1)
+            if not 0 <= nd <= 2:
+                print(f"serve soak: FAIL — recovery daemon made {nd} "
+                      "device dispatches (done entries re-ran?)")
+                failures += 1
+            print(f"serve soak: crash/recover -> {len(pends)} entries "
+                  f"replayed ({n_rec} counted), {nd} device dispatch(es), "
+                  "recovered responses bit-identical to solo", flush=True)
+            with Client(sock2) as c:
+                c.request({"op": "shutdown"})
+            rc3 = daemon3.wait(timeout=60)
+            if rc3 != 0:
+                print(f"serve soak: FAIL — recovery daemon exited {rc3}; "
+                      "stderr tail:")
+                print(open(err3).read()[-2000:])
+                failures += 1
+        except RuntimeError:
+            pass   # already counted as a failure above
+        finally:
+            for dm in (daemon2, daemon3):
+                if dm is not None and dm.poll() is None:
+                    dm.kill()
+                    dm.wait()
     finally:
         if daemon.poll() is None:
             daemon.kill()

@@ -1,0 +1,462 @@
+"""Fleet-hardening tests (r14): the crash-safe request journal and its
+recovery replay, the hung-dispatch watchdog, the device circuit breaker
+with CPU brown-out, per-tenant DRR fairness + rate limits, connection
+caps / idle timeouts, the hard drain bound, seeded retry jitter, the
+health/ready supervisor verbs, and the README knob-table sync."""
+
+import os
+import socket
+import time
+
+import pytest
+
+import tests.conftest  # noqa: F401  (CPU platform + x64)
+from pluss import engine
+from pluss.resilience import CacheCorrupt, CircuitBreaker, FaultPlan, faults
+from pluss.resilience.errors import Overloaded
+from pluss.resilience.ladder import Retry
+from pluss.serve import AdmissionQueue, Client, RequestJournal, ServeConfig, \
+    Server
+from pluss.serve.journal import RequestJournal as _RJ  # noqa: F401
+from pluss.serve.protocol import parse_request
+
+from tests.test_serve_server import (  # noqa: F401  (shared fixtures)
+    clean_faults,
+    server_factory,
+    solo_spec,
+)
+
+_GEMM = {"model": "gemm", "n": 16, "threads": 2, "chunk": 2,
+         "output": "both"}
+
+
+# ---------------------------------------------------------------------------
+# request journal (unit)
+
+
+def test_journal_open_done_roundtrip(tmp_path):
+    path = str(tmp_path / "j" / "serve_journal.jsonl")
+    j = RequestJournal(path)
+    j.append("a", {"id": "a", "model": "gemm"}, tenant="t1",
+             deadline_epoch=123.5)
+    j.append("b", {"id": "b", "model": "mvt"})
+    j.complete("a")
+    assert j.is_open("b") and not j.is_open("a")
+    assert [r["rid"] for r in j.unanswered()] == ["b"]
+    # a fresh load (the restart path) sees the same open set, with the
+    # original request object and deadline preserved
+    j2 = RequestJournal(path)
+    (rec,) = j2.unanswered()
+    assert rec["obj"] == {"id": "b", "model": "mvt"}
+    assert rec.get("deadline_epoch") is None
+    j3 = RequestJournal(path)
+    assert j3.unanswered()[0]["rid"] == "b"
+    # completing an unknown rid is a no-op, not an error (recovery paths
+    # complete defensively)
+    j.complete("never-seen")
+
+
+def test_journal_torn_final_line_tolerated(tmp_path, capsys):
+    path = str(tmp_path / "serve_journal.jsonl")
+    j = RequestJournal(path)
+    j.append("a", {"id": "a"})
+    j.append("b", {"id": "b"})
+    with open(path, "a") as fh:   # the crash artifact: a torn append
+        fh.write('{"rid": "c", "st": "op')
+    j2 = RequestJournal(path)
+    assert [r["rid"] for r in j2.unanswered()] == ["a", "b"]
+    assert "crash artifact" in capsys.readouterr().err
+
+
+def test_journal_midfile_corruption_is_classified(tmp_path):
+    path = str(tmp_path / "serve_journal.jsonl")
+    j = RequestJournal(path)
+    j.append("a", {"id": "a"})
+    with open(path) as fh:
+        good = fh.read()
+    with open(path, "w") as fh:
+        fh.write("NOT JSON AT ALL\n" + good)
+    with pytest.raises(CacheCorrupt):
+        RequestJournal(path)
+
+
+def test_journal_compaction_preserves_open_set(tmp_path):
+    path = str(tmp_path / "serve_journal.jsonl")
+    j = RequestJournal(path, max_records=8)
+    for i in range(8):
+        j.append(f"r{i}", {"id": f"r{i}"})
+        if i != 3:
+            j.complete(f"r{i}")
+    # 8 opens + 7 dones crossed max_records: the file was compacted down
+    # to the open set only
+    with open(path) as fh:
+        lines = [ln for ln in fh.read().splitlines() if ln]
+    assert len(lines) < 15
+    assert [r["rid"] for r in RequestJournal(path).unanswered()] == ["r3"]
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (unit, fake clock)
+
+
+def _fake_clock():
+    t = [0.0]
+
+    def clock():
+        return t[0]
+
+    return t, clock
+
+
+def test_breaker_closed_open_halfopen_closed():
+    t, clock = _fake_clock()
+    b = CircuitBreaker(threshold=2, window_s=10.0, cooldown_s=5.0,
+                       jitter=0.0, clock=clock, name="t.breaker")
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    assert b.state == "closed", "below threshold must stay closed"
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    assert b.retry_after_s() == pytest.approx(5.0)
+    t[0] = 5.1   # cooldown elapses -> half-open, exactly ONE probe
+    assert b.state == "half_open"
+    assert b.allow() and not b.allow()
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+
+
+def test_breaker_reopen_doubles_cooldown():
+    t, clock = _fake_clock()
+    b = CircuitBreaker(threshold=1, window_s=10.0, cooldown_s=2.0,
+                       max_cooldown_s=5.0, jitter=0.0, clock=clock)
+    b.record_failure()
+    assert b.state == "open" and b.retry_after_s() == pytest.approx(2.0)
+    t[0] = 2.1
+    assert b.allow()          # the half-open probe
+    b.record_failure()        # ...fails: reopen with doubled cooldown
+    assert b.state == "open"
+    assert b.retry_after_s() == pytest.approx(4.0)
+    t[0] = 2.1 + 4.1
+    assert b.allow()
+    b.record_failure()
+    assert b.retry_after_s() == pytest.approx(5.0), \
+        "cooldown doubling must cap at max_cooldown_s"
+    # a later success resets the cooldown ladder to its base
+    t[0] = 2.1 + 4.1 + 5.1
+    assert b.allow()
+    b.record_success()
+    b.record_failure()
+    assert b.retry_after_s() == pytest.approx(2.0)
+
+
+def test_breaker_window_prunes_stale_failures():
+    t, clock = _fake_clock()
+    b = CircuitBreaker(threshold=2, window_s=3.0, cooldown_s=1.0,
+                       jitter=0.0, clock=clock)
+    b.record_failure()
+    t[0] = 10.0   # far outside the window: the first failure is stale
+    b.record_failure()
+    assert b.state == "closed", \
+        "failures outside window_s must not accumulate toward the trip"
+
+
+# ---------------------------------------------------------------------------
+# tenant fairness (unit)
+
+
+def _req(rid, tenant=""):
+    return parse_request({"id": rid, "model": "gemm", "n": 16,
+                          "tenant": tenant})
+
+
+def test_drr_interleaves_a_flooding_tenant():
+    q = AdmissionQueue(max_queue=64)
+    for i in range(10):
+        q.submit(_req(f"f{i}", "flood"))
+    for i in range(2):
+        q.submit(_req(f"p{i}", "polite"))
+    order = []
+    while True:
+        req, expired = q.pop(timeout=0)
+        assert not expired
+        if req is None:
+            break
+        order.append(req.id)
+    # one request per tenant per ring pass: the polite tenant's requests
+    # land at positions 1 and 3, not behind the whole flood
+    assert order.index("p0") == 1 and order.index("p1") == 3
+    assert order[0] == "f0"
+
+
+def test_single_tenant_degenerates_to_fifo():
+    q = AdmissionQueue(max_queue=64)
+    for i in range(6):
+        q.submit(_req(f"r{i}"))
+    popped = [q.pop(timeout=0)[0].id for _ in range(6)]
+    assert popped == [f"r{i}" for i in range(6)]
+
+
+def test_rate_limit_sheds_typed_with_retry_after():
+    q = AdmissionQueue(max_queue=64, tenant_rps=1.0, tenant_burst=1.0)
+    q.submit(_req("a0", "a"))
+    with pytest.raises(Overloaded) as ei:
+        q.submit(_req("a1", "a"))
+    assert ei.value.retry_after_ms and ei.value.retry_after_ms > 0
+    # another tenant has its own bucket and is still admitted
+    q.submit(_req("b0", "b"))
+
+
+def test_flooded_server_still_serves_the_quiet_tenant(server_factory):
+    """The ISSUE-14 fairness bound: a flooding tenant cannot push a
+    second tenant's latency past its own tail — the quiet tenant's one
+    request is served within ~one DRR ring pass of the flood's FIRST
+    dispatch, far ahead of the flood's tail."""
+    srv = server_factory(max_batch=1, max_queue=64, max_delay_ms=1)
+    with Client(srv.socket_path) as c:
+        c.request(_GEMM)   # warm the executable: dispatches become uniform
+        hold = c.send({"sleep_ms": 500})
+        time.sleep(0.15)
+        noisy = [c.send({**_GEMM, "tenant": "noisy"}) for _ in range(8)]
+        quiet = c.send({**_GEMM, "tenant": "quiet"})
+        rq = c.recv(quiet)
+        rn = [c.recv(i) for i in noisy]
+        c.recv(hold)
+    assert rq["ok"] and all(r["ok"] for r in rn)
+    assert rq["latency_ms"] < max(r["latency_ms"] for r in rn), \
+        "the quiet tenant waited out the whole flood: DRR is not popping"
+
+
+# ---------------------------------------------------------------------------
+# watchdog + breaker (integration, injected faults)
+
+
+def test_watchdog_abandons_hung_dispatch(server_factory, clean_faults,
+                                         monkeypatch):
+    monkeypatch.setenv("PLUSS_FAULT_HANG_S", "2.0")
+    srv = server_factory(max_batch=1, dispatch_timeout_s=0.3,
+                         breaker_threshold=100)
+    faults.install(FaultPlan.parse("hang@1"))
+    with Client(srv.socket_path) as c:
+        t0 = time.monotonic()
+        r = c.request(dict(_GEMM, id="hung"))
+        dt = time.monotonic() - t0
+        assert not r["ok"] and r["error"]["type"] == "Overloaded"
+        assert r["error"]["retryable"] is True
+        assert r["error"].get("retry_after_ms", 0) > 0
+        assert dt < 1.5, f"watchdog bound 0.3s, answer took {dt:.2f}s"
+        # the fresh device loop owns the queue: the retry is served
+        r2 = c.request(dict(_GEMM, id="retry"))
+        assert r2["ok"] and r2["mrc"] == solo_spec("gemm", 16)["mrc"]
+
+
+def test_breaker_trips_browns_out_and_recloses(server_factory,
+                                               clean_faults, tmp_path):
+    import numpy as np
+
+    trace_path = tmp_path / "refs.bin"
+    rng = np.random.default_rng(7)
+    rng.integers(0, 512, 4096).astype("<u8").tofile(trace_path)
+    srv = server_factory(max_batch=1, breaker_threshold=2,
+                         breaker_cooldown_s=0.5)
+    solo = solo_spec("gemm", 16)
+    with Client(srv.socket_path) as c:
+        assert c.request({"op": "ready"})["ready"]
+        faults.install(FaultPlan.parse("dispatch_fail@1,dispatch_fail@2"))
+        for _ in range(2):
+            r = c.request(dict(_GEMM))
+            assert not r["ok"] \
+                and r["error"]["type"] == "ResourceExhausted"
+        assert c.request({"op": "health"})["breaker"] == "open"
+        rd = c.request({"op": "ready"})
+        assert not rd["ready"] and any("breaker" in s
+                                       for s in rd["reasons"])
+        # open: spec browns out bit-identically on the host CPU device
+        bo = c.request(dict(_GEMM))
+        assert bo["ok"] and "cpu_brownout" in bo["degradations"]
+        assert bo["mrc"] == solo["mrc"]
+        assert bo["histogram"] == solo["histogram"]
+        # open: trace replay sheds typed with the probe slot attached
+        sh = c.request({"trace": str(trace_path)})
+        assert not sh["ok"] and sh["error"]["type"] == "Overloaded"
+        assert sh["error"].get("retry_after_ms", 0) > 0
+        # cooldown -> half-open -> the probe closes it
+        time.sleep(0.7)
+        pr = c.request(dict(_GEMM))
+        assert pr["ok"] and not pr.get("degradations")
+        assert c.request({"op": "health"})["breaker"] == "closed"
+        assert c.request({"op": "ready"})["ready"]
+
+
+# ---------------------------------------------------------------------------
+# recovery replay (integration)
+
+
+def test_recovery_replays_open_entries_bit_identically(tmp_path):
+    jdir = str(tmp_path / "j")
+    j = RequestJournal(os.path.join(jdir, "serve_journal.jsonl"))
+    j.append("done-0", dict(_GEMM, id="done-0"))
+    j.complete("done-0")
+    j.append("pend-0", dict(_GEMM, id="pend-0"), tenant="t",
+             deadline_epoch=time.time() + 300)
+    j.append("dead-0", {"id": "dead-0", "model": "mvt", "n": 16},
+             deadline_epoch=time.time() - 5)
+    del j
+
+    solo = solo_spec("gemm", 16)   # before the witness snapshot: this
+    d0 = engine.DEVICE_DISPATCHES  # in-process run dispatches too
+    srv = Server(socket_path=str(tmp_path / "r.sock"),
+                 config=ServeConfig(journal_dir=jdir))
+    srv.start()
+    try:
+        with Client(srv.socket_path) as c:
+            def collect(rid, budget=60.0):
+                deadline = time.monotonic() + budget
+                while time.monotonic() < deadline:
+                    r = c.request({"op": "result", "id": rid})
+                    if r.get("op") != "result":
+                        return r
+                    time.sleep(0.1)
+                raise AssertionError(f"{rid} never recovered")
+
+            r = collect("pend-0")
+            assert r["ok"] and r["mrc"] == solo["mrc"]
+            assert r["histogram"] == solo["histogram"]
+            rd = collect("dead-0")
+            assert not rd["ok"] \
+                and rd["error"]["type"] == "DeadlineExceeded"
+            # a collected answer is gone; an unknown rid reports not
+            # pending
+            again = c.request({"op": "result", "id": "pend-0"})
+            assert again.get("op") == "result" and not again["pending"]
+    finally:
+        srv.shutdown(drain_timeout_s=30)
+    # the zero-recompute witness: ONE dispatch (pend-0); the completed
+    # entry and the expired one never touched the device
+    assert engine.DEVICE_DISPATCHES - d0 == 1
+    # nothing left open after the drain
+    assert not RequestJournal(
+        os.path.join(jdir, "serve_journal.jsonl")).unanswered()
+
+
+# ---------------------------------------------------------------------------
+# hard drain bound
+
+
+def test_drain_hard_bound_answers_stuck_work(clean_faults, tmp_path,
+                                             monkeypatch):
+    monkeypatch.setenv("PLUSS_FAULT_HANG_S", "6.0")
+    # watchdog disabled: the hang really wedges the dispatch, and only
+    # the drain bound can save shutdown.  The wedged thread outlives the
+    # test as a sleeping zombie; the claimed-member filter in the
+    # executors keeps it from dispatching anything when it wakes.
+    srv = Server(socket_path=str(tmp_path / "d.sock"),
+                 config=ServeConfig(max_batch=1, dispatch_timeout_s=0))
+    srv.start()
+    faults.install(FaultPlan.parse("hang@1"))
+    c = Client(srv.socket_path)
+    stuck = c.send(dict(_GEMM, id="stuck"))
+    time.sleep(0.3)   # the hang must reach the device
+    queued = c.send(dict(_GEMM, id="queued"))
+    t0 = time.monotonic()
+    srv.shutdown(drain_timeout_s=0.5)
+    dt = time.monotonic() - t0
+    assert dt < 10, f"drain bound 0.5s did not bound shutdown ({dt:.1f}s)"
+    rs = {rid: c.recv(rid) for rid in (stuck, queued)}
+    c.close()
+    for rid, r in rs.items():
+        assert not r["ok"] and r["error"]["type"] == "Overloaded", \
+            f"{rid} was not answered typed retryable by the forced drain"
+        assert r["error"]["retryable"] is True
+
+
+# ---------------------------------------------------------------------------
+# connection cap + idle timeout
+
+
+def test_conn_cap_sheds_typed_at_accept(server_factory):
+    import json as _json
+
+    srv = server_factory(max_conns=1)
+    with Client(srv.socket_path) as c1:
+        assert c1.request({"op": "ping"})["ok"]
+        s2 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s2.settimeout(10)
+        s2.connect(srv.socket_path)
+        line = s2.makefile("rb").readline()
+        s2.close()
+        doc = _json.loads(line)
+        assert not doc["ok"] and doc["error"]["type"] == "Overloaded"
+        assert doc["error"].get("retry_after_ms", 0) > 0
+    # the capped connection closing frees the slot
+    time.sleep(0.2)
+    with Client(srv.socket_path) as c3:
+        assert c3.request({"op": "ping"})["ok"]
+
+
+def test_idle_connection_is_reclaimed(server_factory):
+    srv = server_factory(conn_idle_s=0.3)
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(10)
+    s.connect(srv.socket_path)
+    time.sleep(0.8)   # stay silent past the idle bound
+    assert s.recv(1) == b"", "idle connection was not closed"
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# seeded retry jitter
+
+
+def test_retry_jitter_is_seeded_and_bounded(monkeypatch):
+    import pluss.resilience.ladder as ladder_mod
+
+    slept: list[float] = []
+    monkeypatch.setattr(ladder_mod.time, "sleep",
+                        lambda s: slept.append(s))
+    r1 = Retry(backoff_s=0.1, backoff_cap_s=1.0, jitter_seed=42)
+    for a in range(5):
+        r1.sleep(a)
+    first = list(slept)
+    slept.clear()
+    r2 = Retry(backoff_s=0.1, backoff_cap_s=1.0, jitter_seed=42)
+    for a in range(5):
+        r2.sleep(a)
+    assert slept == first, "equal seeds must reproduce the schedule"
+    for a, s in enumerate(first):
+        assert 0.0 <= s <= min(0.1 * 2 ** a, 1.0), \
+            "full jitter must stay within the deterministic envelope"
+    slept.clear()
+    Retry(backoff_s=0.1, jitter_seed=43).sleep(3)
+    assert slept != first[3:4], "different seeds should diverge"
+
+
+# ---------------------------------------------------------------------------
+# README sync
+
+
+def test_readme_production_serving_is_synced():
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    start = readme.index("## Production serving")
+    section = readme[start:readme.index("## Warm start")]
+    for knob in ("PLUSS_SERVE_JOURNAL", "PLUSS_SERVE_JOURNAL_MAX_RECORDS",
+                 "PLUSS_SERVE_DISPATCH_TIMEOUT_S",
+                 "PLUSS_SERVE_BREAKER_THRESHOLD",
+                 "PLUSS_SERVE_BREAKER_WINDOW_S",
+                 "PLUSS_SERVE_BREAKER_COOLDOWN_S",
+                 "PLUSS_SERVE_TENANT_RPS", "PLUSS_SERVE_TENANT_BURST",
+                 "PLUSS_SERVE_MAX_CONNS", "PLUSS_SERVE_CONN_IDLE_S",
+                 "--journal-dir", "--recover", "--drain-timeout-s"):
+        assert knob in section, f"README knob table missing {knob}"
+    for needle in ("cpu_brownout", '"op": "result"', "half-open",
+                   "device_dispatches", "serve hardening:"):
+        assert needle in section, f"README serving section missing {needle}"
+
+
+def test_smoke_module_runs():
+    """The run.sh tier-1 gate, as a pytest wrapper (same pattern as
+    tests/test_residency.py): the full health→trip→brown-out→shed→
+    probe→close loop must pass in-process."""
+    from pluss import hardening_smoke
+
+    assert hardening_smoke.main() == 0
