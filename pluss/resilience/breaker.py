@@ -141,6 +141,23 @@ class CircuitBreaker:
                 self._cooldown_s = self.base_cooldown_s
                 self._transition("closed", "close")
 
+    def release_probe(self) -> None:
+        """Release a half-open probe that ended without device evidence.
+
+        Every ``allow() == True`` in half-open MUST resolve — via
+        :meth:`record_success`, :meth:`record_failure`, or this.  A probe
+        dispatch can die in ways that say nothing about the device (a
+        deadline blown inside the ladder, a client-classified error,
+        every batch member already claimed by the watchdog so nothing
+        dispatched at all); without this release the probe slot would
+        leak and ``allow()`` would answer ``False`` forever — the
+        breaker wedged half-open until process restart.  The state stays
+        half-open and the NEXT caller gets the probe.  No-op unless an
+        unresolved probe is actually held."""
+        with self._lock:
+            if self._state == "half_open" and self._probing:
+                self._probing = False
+
     def record_failure(self) -> None:
         """One classified device failure (never client/deadline errors)."""
         with self._lock:

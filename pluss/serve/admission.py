@@ -56,9 +56,10 @@ from pluss.serve.protocol import Request
 _QUANTUM = 1.0
 _COST = 1.0
 
-#: hostile-tenant guard: the token-bucket table never grows past this
-#: (full, idle buckets are evicted first — they hold no state a refill
-#: wouldn't recreate)
+#: hostile-tenant guard: the token-bucket table never grows past this —
+#: a HARD bound.  Full, idle buckets are evicted first (they hold no
+#: state a refill wouldn't recreate); when none qualify, the stalest
+#: bucket by last-touch time goes instead
 _MAX_BUCKETS = 4096
 
 #: suggested client back-off for a queue-full shed, where no token-refill
@@ -156,6 +157,15 @@ class AdmissionQueue:
                 for k in [k for k, v in self._buckets.items()
                           if v[0] >= self.tenant_burst and k not in self._q]:
                     del self._buckets[k]
+                while len(self._buckets) >= _MAX_BUCKETS:
+                    # hard bound: a flood of unique tenant ids leaves no
+                    # bucket full (each was just decremented), so fall
+                    # back to evicting the stalest by last-touch time —
+                    # the forgotten debt is at most one burst, the table
+                    # size is a guarantee
+                    stalest = min(self._buckets,
+                                  key=lambda k: self._buckets[k][1])
+                    del self._buckets[stalest]
             b = self._buckets[tenant] = [self.tenant_burst, now]
         b[0] = min(self.tenant_burst,
                    b[0] + (now - b[1]) * self.tenant_rps)

@@ -66,6 +66,7 @@ class RequestJournal:
         lines = raw.split(b"\n")
         if lines and lines[-1] == b"":
             lines.pop()
+        good_end = 0   # byte offset just past the last good line's \n
         for i, line in enumerate(lines):
             try:
                 rec = json.loads(line)
@@ -73,19 +74,41 @@ class RequestJournal:
             except (ValueError, KeyError, TypeError):
                 if i == len(lines) - 1:
                     # torn final line: the crash artifact append-fsync
-                    # journals are allowed to leave behind
+                    # journals are allowed to leave behind.  It must be
+                    # truncated AWAY, not just skipped: _write opens in
+                    # append mode, so leftover partial bytes would merge
+                    # with the next record into one corrupt line — and
+                    # THAT poisons the next restart as mid-file
+                    # corruption (CacheCorrupt, daemon refuses to start)
                     print(f"pluss: serve journal {self.path}: dropping "
                           "torn final line (crash artifact)",
                           file=sys.stderr)
-                    continue
+                    self._truncate(good_end)
+                    break
                 raise CacheCorrupt(
                     f"serve journal {self.path} line {i + 1} is corrupt; "
                     "delete the file to reset", site="serve.journal")
+            good_end += len(line) + 1
             self._n_lines += 1
             if st == "open":
                 self._open[rid] = rec
             else:
                 self._open.pop(rid, None)
+        else:
+            if good_end > len(raw):
+                # the final record parsed but its trailing newline was
+                # torn off (the one-byte-short crash): complete the line
+                # so the next append starts a fresh one
+                with open(self.path, "ab") as fh:
+                    fh.write(b"\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+
+    def _truncate(self, offset: int) -> None:
+        with open(self.path, "r+b") as fh:
+            fh.truncate(offset)
+            fh.flush()
+            os.fsync(fh.fileno())
 
     # ------------------------------------------------------------------
     # the admission-side protocol: append -> complete

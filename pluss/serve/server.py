@@ -100,6 +100,13 @@ SERVE_TRACE_LADDER: tuple[str, ...] = ("serial_feed", "shrink_window")
 #: traffic here BEFORE requests start shedding, not after
 READY_HIGHWATER = 0.8
 
+#: parked recovered-response bound: answers for clients that never
+#: reconnect must not accumulate for the daemon's whole life (each holds
+#: a full result payload).  Past the cap the OLDEST parked answer is
+#: dropped — its client can still re-submit; the journal entry is
+#: already complete
+_MAX_RECOVERED = 1024
+
 
 @dataclasses.dataclass
 class ServeConfig:
@@ -404,6 +411,11 @@ class Server:
             def park(doc: dict, rid=rid) -> None:
                 with self._recovered_lock:
                     self._recovered[rid] = doc
+                    while len(self._recovered) > _MAX_RECOVERED:
+                        # dicts iterate in insertion order: evict oldest
+                        oldest = next(iter(self._recovered))
+                        del self._recovered[oldest]
+                        obs.counter_add("serve.journal.recovered_evicted")
                 obs.counter_add("serve.journal.recovered")
 
             if dle is not None and time.time() >= dle:
@@ -921,8 +933,16 @@ class Server:
                                      len(live))
                     return
                 if not self.breaker.allow():
+                    # the brown-out dispatch rides the SAME watchdog
+                    # window as a device dispatch: a wedged CPU compile
+                    # or injected hang must be abandoned, not wedge the
+                    # loop with the breaker open
                     brownout = True
-                    self._brownout(live)
+                    self._set_inflight(gen, live)
+                    try:
+                        self._brownout(live)
+                    finally:
+                        self._clear_inflight(gen)
                     return
                 self._set_inflight(gen, live)
                 try:
@@ -940,6 +960,12 @@ class Server:
                             live, on_success=self.breaker.record_success)
                 finally:
                     self._clear_inflight(gen)
+                    # allow() may have granted this dispatch the half-
+                    # open probe; if it ended without record_success /
+                    # record_failure (deadline, client error, every
+                    # member claimed), free the slot — a leaked probe
+                    # wedges the breaker half-open forever
+                    self.breaker.release_probe()
             except BaseException as e:  # noqa: BLE001 — typed fan-out
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     raise

@@ -68,6 +68,39 @@ def test_journal_torn_final_line_tolerated(tmp_path, capsys):
     assert "crash artifact" in capsys.readouterr().err
 
 
+def test_journal_torn_tail_is_truncated_for_future_appends(tmp_path):
+    """Dropping the torn line is not enough: _write appends, so leftover
+    partial bytes would merge with the next record into one corrupt line
+    — which the NEXT restart classifies as mid-file corruption and
+    refuses to start on.  The torn tail must be truncated away."""
+    path = str(tmp_path / "serve_journal.jsonl")
+    j = RequestJournal(path)
+    j.append("a", {"id": "a"})
+    with open(path, "a") as fh:
+        fh.write('{"rid": "b", "st": "op')   # torn append, no newline
+    j2 = RequestJournal(path)   # drops AND truncates the tear
+    j2.append("c", {"id": "c"})             # must start a fresh line
+    assert [r["rid"] for r in RequestJournal(path).unanswered()] \
+        == ["a", "c"]
+
+
+def test_journal_missing_final_newline_is_repaired(tmp_path):
+    """A crash can tear off JUST the trailing newline: the final record
+    parses fine but the next append would merge onto it.  Load completes
+    the line instead of dropping a live record."""
+    path = str(tmp_path / "serve_journal.jsonl")
+    j = RequestJournal(path)
+    j.append("a", {"id": "a"})
+    with open(path, "r+b") as fh:
+        fh.seek(0, os.SEEK_END)
+        fh.truncate(fh.tell() - 1)
+    j2 = RequestJournal(path)
+    assert [r["rid"] for r in j2.unanswered()] == ["a"]
+    j2.append("b", {"id": "b"})
+    assert [r["rid"] for r in RequestJournal(path).unanswered()] \
+        == ["a", "b"]
+
+
 def test_journal_midfile_corruption_is_classified(tmp_path):
     path = str(tmp_path / "serve_journal.jsonl")
     j = RequestJournal(path)
@@ -149,6 +182,22 @@ def test_breaker_reopen_doubles_cooldown():
     assert b.retry_after_s() == pytest.approx(2.0)
 
 
+def test_breaker_release_probe_frees_wedged_halfopen():
+    t, clock = _fake_clock()
+    b = CircuitBreaker(threshold=1, cooldown_s=1.0, jitter=0.0,
+                       clock=clock)
+    b.record_failure()
+    t[0] = 1.1
+    assert b.allow() and not b.allow()   # the one probe slot is held
+    b.release_probe()   # the probe dispatch died without device evidence
+    assert b.state == "half_open"
+    assert b.allow(), "released probe slot must be re-grantable"
+    b.record_success()
+    assert b.state == "closed"
+    b.release_probe()   # no-op outside half-open
+    assert b.state == "closed" and b.allow()
+
+
 def test_breaker_window_prunes_stale_failures():
     t, clock = _fake_clock()
     b = CircuitBreaker(threshold=2, window_s=3.0, cooldown_s=1.0,
@@ -204,6 +253,19 @@ def test_rate_limit_sheds_typed_with_retry_after():
     assert ei.value.retry_after_ms and ei.value.retry_after_ms > 0
     # another tenant has its own bucket and is still admitted
     q.submit(_req("b0", "b"))
+
+
+def test_bucket_table_is_hard_bounded(monkeypatch):
+    """A flood of unique tenant ids leaves every bucket just-decremented
+    (never idle-full), so the soft eviction finds nothing — the stalest-
+    bucket fallback must keep the table at the cap anyway."""
+    from pluss.serve import admission as adm
+
+    monkeypatch.setattr(adm, "_MAX_BUCKETS", 8)
+    q = AdmissionQueue(max_queue=4, tenant_rps=100.0, tenant_burst=2.0)
+    for i in range(50):
+        q._take_token(f"hostile-{i}")
+    assert len(q._buckets) <= 8
 
 
 def test_flooded_server_still_serves_the_quiet_tenant(server_factory):
@@ -287,6 +349,72 @@ def test_breaker_trips_browns_out_and_recloses(server_factory,
         assert c.request({"op": "ready"})["ready"]
 
 
+def test_unresolved_probe_does_not_wedge_breaker(server_factory,
+                                                 clean_faults):
+    """A half-open probe dispatch that dies WITHOUT device evidence (a
+    client-classified error, a deadline, every member claimed) must free
+    the probe slot — pre-fix it leaked, allow() answered False forever,
+    and the breaker sat half-open until restart."""
+    srv = server_factory(max_batch=1, breaker_threshold=1,
+                         breaker_cooldown_s=0.2)
+    with Client(srv.socket_path) as c:
+        assert c.request(dict(_GEMM))["ok"]   # warm, known-good
+        faults.install(FaultPlan.parse("dispatch_fail@1"))
+        assert not c.request(dict(_GEMM))["ok"]
+        assert c.request({"op": "health"})["breaker"] == "open"
+        time.sleep(0.35)          # cooldown (jittered +20% max) elapses
+        orig = srv._execute_spec
+        state = {"boomed": False}
+
+        def probe_vanishes(batch, **kw):
+            if not state["boomed"]:
+                state["boomed"] = True
+                raise RuntimeError("probe vanished, no device evidence")
+            return orig(batch, **kw)
+
+        srv._execute_spec = probe_vanishes
+        try:
+            r = c.request(dict(_GEMM))        # the probe dispatch dies
+            assert not r["ok"] and r["error"]["type"] == "PlussError"
+            # the slot was released: the NEXT request takes the probe
+            # and closes the breaker instead of browning out forever
+            r2 = c.request(dict(_GEMM))
+            assert r2["ok"] and not r2.get("degradations")
+            assert c.request({"op": "health"})["breaker"] == "closed"
+        finally:
+            srv._execute_spec = orig
+
+
+def test_watchdog_bounds_brownout_dispatch(server_factory, clean_faults):
+    """The CPU brown-out dispatch rides the same watchdog window as a
+    device dispatch: a wedge while the breaker is open must be abandoned
+    and answered, not hang the device loop forever."""
+    srv = server_factory(max_batch=1, breaker_threshold=1,
+                         breaker_cooldown_s=30.0, dispatch_timeout_s=0.3)
+    with Client(srv.socket_path) as c:
+        assert c.request(dict(_GEMM))["ok"]
+        faults.install(FaultPlan.parse("dispatch_fail@1"))
+        assert not c.request(dict(_GEMM))["ok"]
+        assert c.request({"op": "health"})["breaker"] == "open"
+        orig = srv._execute_spec
+
+        def wedged(batch, **kw):
+            time.sleep(2.0)       # a wedged brown-out compile
+            return orig(batch, **kw)
+
+        srv._execute_spec = wedged
+        try:
+            t0 = time.monotonic()
+            r = c.request(dict(_GEMM))   # breaker open -> brown-out path
+            dt = time.monotonic() - t0
+        finally:
+            srv._execute_spec = orig
+        assert not r["ok"] and r["error"]["type"] == "Overloaded"
+        assert r["error"]["retryable"] is True
+        assert dt < 1.5, \
+            f"brown-out watchdog bound 0.3s, answer took {dt:.2f}s"
+
+
 # ---------------------------------------------------------------------------
 # recovery replay (integration)
 
@@ -336,6 +464,23 @@ def test_recovery_replays_open_entries_bit_identically(tmp_path):
     # nothing left open after the drain
     assert not RequestJournal(
         os.path.join(jdir, "serve_journal.jsonl")).unanswered()
+
+
+def test_recovered_parking_is_bounded(tmp_path, monkeypatch):
+    """Parked recovered answers for clients that never reconnect must
+    not accumulate for the daemon's whole life: past the cap the oldest
+    parked answer is evicted (its journal entry is already complete; the
+    client can re-submit)."""
+    import pluss.serve.server as server_mod
+
+    monkeypatch.setattr(server_mod, "_MAX_RECOVERED", 2)
+    srv = Server(socket_path=str(tmp_path / "x.sock"),
+                 config=ServeConfig(journal_dir=str(tmp_path / "j")))
+    pending = [{"rid": f"r{i}", "obj": {"id": f"r{i}", "model": "gemm"},
+                "deadline_epoch": time.time() - 5} for i in range(5)]
+    srv._recover_loop(pending)   # every entry parks a typed answer
+    assert set(srv._recovered) == {"r3", "r4"}, \
+        "the parking table must hold only the newest _MAX_RECOVERED"
 
 
 # ---------------------------------------------------------------------------
