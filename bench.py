@@ -1042,6 +1042,58 @@ def bench_predict(check_n: int = 16) -> None:
     }), flush=True)
 
 
+def bench_cotenancy(n: int = 16) -> None:
+    """Co-tenancy composition headlines (round r15 on): wall time of the
+    full `pluss cotenancy` pipeline (derive -> heterogeneous-rate CRI
+    composition -> AET read-off) on the gemm+syrk pair — pure host math,
+    the latency a serve interference advisory pays — plus the composed
+    curves' max pointwise error against the interleaved schedule-
+    simulation oracle (exact LRU stack distances on the merged stream)."""
+    import numpy as np
+
+    from pluss.analysis import interference as itf
+    from pluss.config import DEFAULT
+
+    t0 = time.perf_counter()
+    inputs, _ = itf.from_models(["gemm", "syrk"], DEFAULT, n=n)
+    rep = itf.compose(inputs, DEFAULT)
+    dt = time.perf_counter() - t0
+    log(f"bench: cotenancy gemm+syrk compose at n={n}: {dt * 1e3:.0f} ms, "
+        f"{len(rep.verdicts)} verdict(s), zero device dispatches")
+    print(json.dumps({
+        "metric": "cotenancy_predict_ms",
+        "value": round_keep(dt * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "path": "analysis.interference.compose(gemm+syrk)",
+        "degradations": [],
+        "spec_source": "registry",
+        "n": n,
+        "verdicts": [v.code for v in rep.verdicts],
+    }), flush=True)
+
+    oracle = itf.oracle_mrcs(inputs, DEFAULT)
+    max_err, worst = 0.0, None
+    for w, pred, orc in zip(inputs, rep.degraded_curves, oracle):
+        m = min(len(pred), len(orc))
+        err = float(np.max(np.abs(np.asarray(pred[:m]) - orc[:m])))
+        if err > max_err:
+            max_err, worst = err, w.name
+    log(f"bench: cotenancy max abs composed-MRC error vs oracle at "
+        f"n={n}: {max_err:.3g}" + (f" ({worst})" if worst else ""))
+    print(json.dumps({
+        "metric": "cotenancy_max_abs_err",
+        "value": round_keep(max_err, 9),
+        "unit": "abs_mrc_error",
+        "vs_baseline": None,
+        "path": "analysis.interference vs schedule-simulation oracle",
+        "degradations": [],
+        "spec_source": "registry",
+        "n": n,
+        "worst_workload": worst,
+    }), flush=True)
+
+
 def main() -> int:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
     # persistent XLA compilation cache: the flagship compiles cost minutes
@@ -1110,6 +1162,11 @@ def main() -> int:
                 bench_predict()
             except Exception as e:
                 log(f"bench: predict metric failed: {e}")
+        if budget_ok("cotenancy", 60):
+            try:
+                bench_cotenancy()
+            except Exception as e:
+                log(f"bench: cotenancy metric failed: {e}")
         if budget_ok("warmstart", 180):
             try:
                 bench_warmstart(128, cpu=True)
@@ -1282,6 +1339,14 @@ def main() -> int:
             bench_predict()
         except Exception as e:
             log(f"bench: predict metric failed: {e}")
+
+    # co-tenancy composition headlines (round r15 on): host-only compose
+    # latency + composed-MRC error vs the schedule-simulation oracle
+    if budget_ok("cotenancy", 60):
+        try:
+            bench_cotenancy()
+        except Exception as e:
+            log(f"bench: cotenancy metric failed: {e}")
 
     # accuracy half of the north star (BASELINE.json: "miss-ratio-curve L2
     # error vs C++ baseline" within 1%): MRC of the TPU pipeline vs the

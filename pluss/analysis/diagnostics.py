@@ -32,6 +32,11 @@ Code families mirror the analyzer's four passes:
   refusals (PL701), enumeration-budget refusals (PL702), derivation-method
   notes (PL703), and the prover soundness alarm (PL704: exact plateau
   outside the heuristic MrcBracket — a bug in exactly one of the two).
+- ``PL8xx`` interference (:mod:`pluss.analysis.interference`): the
+  cross-nest co-tenancy composition — severe predicted interference at
+  the declared cache size (PL801), proven-bounded benign co-tenancy
+  (PL802), and the typed refusal when a workload pair lies outside the
+  composition model's contract (PL803 — never a silent approximation).
 
 Severity semantics: ERROR means the spec is wrong (out-of-bounds access,
 undeclared array, contract violation) — ``pluss lint`` exits nonzero.
@@ -121,6 +126,15 @@ CODES: dict[str, tuple[str, str]] = {
     "PL704": ("prediction", "exact MRC plateau lies outside the static "
                             "footprint bracket — prover soundness "
                             "violation"),
+    "PL801": ("interference", "severe co-tenancy interference: predicted "
+                              "miss-ratio inflation above threshold at "
+                              "the declared cache size"),
+    "PL802": ("interference", "benign co-tenancy: miss-ratio inflation "
+                              "proven below threshold at the declared "
+                              "cache size"),
+    "PL803": ("interference", "co-tenancy pair outside the composition "
+                              "model's contract (typed refusal, never a "
+                              "silent approximation)"),
 }
 
 

@@ -483,10 +483,12 @@ def predict(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     return report
 
 
-#: stated MRC tolerance of the predict≡engine contract: the histograms
-#: are bit-identical, but the CRI pass accumulates floats in dict
-#: insertion order and the engine's share dicts carry device-merge
-#: order, so the composed curves may differ by summation-order ulps
+#: stated MRC tolerance of the predict≡engine contract.  Since r15 the
+#: CRI pass accumulates floats in SORTED key order (pluss/cri.py), so
+#: equal histograms compose to BIT-IDENTICAL curves regardless of dict
+#: insertion or device-merge order — ``mrc_exact`` is the expected
+#: outcome on every family, and the epsilon is kept only as a stated
+#: contract bound, not an observed error
 MRC_EPS = 1e-9
 
 

@@ -136,6 +136,7 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
     all_diags = []
     footprints: dict[str, dict] = {}
     predictions: dict[str, dict] = {}
+    hierarchies: dict[str, dict] = {}
     errors = 0
     for name, spec in targets:
         if cfg is None:
@@ -152,6 +153,14 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
             rep = ri.predict(spec, cfg)
             predictions[spec.name] = ri.report_doc(rep)
             diags = diags + rep.prediction.diagnostics
+            if rep.rihist is not None:
+                # AET-exact hierarchy read-offs from the derived
+                # histogram (pluss/model/hierarchy.py; PLUSS_CACHE_*
+                # knobs pick levels/assoc/policy)
+                from pluss.model import hierarchy as hier_mod
+
+                hierarchies[spec.name] = hier_mod.hierarchy_doc(
+                    rep.rihist, cfg)
         all_diags += analysis.with_model(diags, spec.name)
         errors += analysis.error_count(diags)
     mode = "lint" if cfg is None else "analyze"
@@ -168,6 +177,7 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
                                "ds": cfg.ds, "cls": cfg.cls}
             doc["footprint"] = footprints
             doc["prediction"] = predictions
+            doc["hierarchy"] = hierarchies
         out.write(json_mod.dumps(doc, indent=1) + "\n")
     else:
         text = analysis.format_text(all_diags)
@@ -183,6 +193,13 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
                     f"[{doc['mrc_plateau_bounds'][0]}, "
                     f"{doc['mrc_plateau_bounds'][1]}]\n")
                 out.write(_prediction_line(name, predictions[name]))
+                if name in hierarchies:
+                    from pluss.model import hierarchy as hier_mod
+
+                    for line in hier_mod.render_hierarchy(
+                            hierarchies[name], indent="    "):
+                        out.write(f"  {line}\n" if line == "hierarchy:"
+                                  else f"{line}\n")
         n_warn = sum(1 for d in all_diags
                      if d.severity is analysis.Severity.WARNING)
         out.write(f"pluss {mode}: {len(targets)} model(s), {errors} "
@@ -282,6 +299,88 @@ def _predict_main(args, p, out, setup_platform) -> int:
                         if r.prediction.derivable)
         out.write(f"pluss predict: {n_derived}/{len(reports)} model(s) "
                   f"derivable, {errors} error(s)\n")
+    return rc
+
+
+def _cotenancy_main(args, p, out) -> int:
+    """``pluss cotenancy <a+b[+...]> [--json|--sarif|--check]`` — the
+    cross-nest co-tenancy composition (:mod:`pluss.analysis.
+    interference`): per-workload degraded MRCs off the merged stream's
+    AET clock plus PL801/PL802/PL803 verdicts.  ``--check`` pins the
+    composed curves against the interleaved schedule-simulation oracle
+    (pure host numpy; no device).  Malformed target lists are usage
+    errors, never tracebacks."""
+    import json as json_mod
+
+    from pluss.analysis import interference
+
+    if not args.target:
+        p.error("cotenancy mode requires a modelA+modelB[+...] target")
+    names = [t.strip() for t in args.target.split("+")]
+    if any(not t for t in names):
+        p.error(f"cotenancy mode: malformed target {args.target!r} "
+                "(empty workload name)")
+    unknown = [t for t in names if t not in REGISTRY]
+    if unknown:
+        p.error(f"cotenancy mode: unknown model(s) "
+                f"{', '.join(map(repr, unknown))}")
+    if len(names) < 2:
+        p.error("cotenancy mode: co-tenancy needs >= 2 workloads "
+                f"(got {args.target!r}; join them with '+')")
+    cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk,
+                        **({} if args.cache_kb is None
+                           else {"cache_kb": args.cache_kb}))
+    inputs, refusals = interference.from_models(names, cfg, args.n)
+    if len(inputs) < 2:
+        rep = interference.CotenancyReport(
+            tuple(names), cfg.cache_kb,
+            interference.interference_threshold(), [], [], [], [], {},
+            refusals)
+    else:
+        rep = interference.compose(inputs, cfg)
+        rep.diagnostics = refusals + rep.diagnostics
+    rc = 1 if len(inputs) < 2 else 0
+    doc = rep.doc()
+    if args.check and len(inputs) >= 2:
+        ok, detail = interference.check_against_oracle(rep, inputs, cfg)
+        doc["check"] = detail
+        for wd in detail["per_workload"]:
+            status = "ok" if wd["ok"] else "CHECK FAILED"
+            print(f"pluss cotenancy: {wd['workload']}: {status} "
+                  f"(max|err| {wd['max_abs_err']:.3g}, mae "
+                  f"{wd['mae']:.3g}, edge {wd['edge_err']:.3g}, solo "
+                  f"max|err| {wd['solo_max_abs_err']:.3g})",
+                  file=sys.stderr)
+        if not ok:
+            rc = 1
+    elif args.check:
+        print("pluss cotenancy: check skipped (fewer than 2 composable "
+              "workloads)", file=sys.stderr)
+    if args.sarif:
+        from pluss.analysis import sarif as sarif_mod
+
+        sarif_mod.write_sarif(args.sarif, rep.diagnostics)
+        print(f"pluss cotenancy: SARIF log at {args.sarif}",
+              file=sys.stderr)
+    if args.json:
+        doc["schedule"] = {"threads": cfg.thread_num,
+                           "chunk": cfg.chunk_size,
+                           "ds": cfg.ds, "cls": cfg.cls}
+        out.write(json_mod.dumps(doc, indent=1) + "\n")
+    else:
+        for d in rep.diagnostics:
+            if d.code == "PL803":
+                out.write(d.format() + "\n")
+        for v in rep.verdicts:
+            out.write(f"{v.name}: solo {v.solo_mr:.6g} -> degraded "
+                      f"{v.degraded_mr:.6g} (+{v.inflation:.6g}) "
+                      f"[{v.code}] share p={v.p:.4g}\n")
+        n_sev = sum(1 for v in rep.verdicts if v.code == "PL801")
+        n_ref = sum(1 for d in rep.diagnostics if d.code == "PL803")
+        out.write(f"pluss cotenancy: {len(names)} workload(s) at "
+                  f"{rep.cache_kb} KB, threshold {rep.threshold:g}: "
+                  f"{n_sev} severe, {len(rep.verdicts) - n_sev} benign, "
+                  f"{n_ref} refused\n")
     return rc
 
 
@@ -477,12 +576,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("mode",
                    choices=("acc", "speed", "mrc", "trace", "sweep",
                             "sample", "lint", "analyze", "predict",
-                            "stats", "serve", "import", "spec"))
+                            "cotenancy", "stats", "serve", "import",
+                            "spec"))
     p.add_argument("target", nargs="?", default=None,
                    help="stats mode: telemetry event stream (events.jsonl) "
                         "to aggregate; import mode: the .py (DSL) or .c "
                         "(pragma-C) source file; spec mode: dump | load; "
-                        "predict mode: the model to predict")
+                        "predict mode: the model to predict; cotenancy "
+                        "mode: the co-scheduled workloads as "
+                        "modelA+modelB[+...]")
     p.add_argument("arg2", nargs="?", default=None,
                    help="spec mode: the model to dump / the spec JSON "
                         "file to load")
@@ -549,6 +651,10 @@ def main(argv: list[str] | None = None) -> int:
                         "a point whose worker dies); default serial")
     p.add_argument("--threads", type=int, default=4, help="simulated threads")
     p.add_argument("--chunk", type=int, default=4, help="schedule chunk size")
+    p.add_argument("--cache-kb", type=int, default=None, metavar="KB",
+                   help="cotenancy mode: shared-cache capacity in KB for "
+                        "the verdict point (default: the SamplerConfig "
+                        "cache_kb)")
     p.add_argument("--reps", type=int, default=3, help="speed-mode repetitions")
     p.add_argument("--share-cap", type=int, default=SHARE_CAP)
     p.add_argument("--window", type=int, default=None,
@@ -683,15 +789,16 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
 
     if args.target is not None and args.mode not in ("stats", "import",
-                                                     "spec", "predict"):
+                                                     "spec", "predict",
+                                                     "cotenancy"):
         # the optional positionals exist only for `stats <events.jsonl>`,
-        # `import <file>`, `spec <dump|load> <what>`, and
-        # `predict <model>`; anywhere else a stray argument must stay the
-        # usage error it always was (`pluss lint gemm` would otherwise
+        # `import <file>`, `spec <dump|load> <what>`, `predict <model>`,
+        # and `cotenancy <a+b>`; anywhere else a stray argument must stay
+        # the usage error it always was (`pluss lint gemm` would otherwise
         # silently lint the DEFAULT model and report it clean)
         p.error(f"unexpected argument {args.target!r} for mode "
                 f"{args.mode!r} (positional input is for stats/import/"
-                "spec/predict modes only; use --model/--file)")
+                "spec/predict/cotenancy modes only; use --model/--file)")
     if args.arg2 is not None and args.mode != "spec":
         p.error(f"unexpected argument {args.arg2!r} for mode "
                 f"{args.mode!r}")
@@ -758,6 +865,13 @@ def main(argv: list[str] | None = None) -> int:
         # no platform setup — --check alone boots a device for the
         # engine cross-run
         return _predict_main(args, p, sys.stdout, setup_platform)
+
+    if args.mode == "cotenancy":
+        # cross-nest co-tenancy interference (pluss/analysis/
+        # interference.py): pure host math end to end — even --check,
+        # whose oracle is a numpy schedule simulation, never boots a
+        # device
+        return _cotenancy_main(args, p, sys.stdout)
 
     setup_platform()
 
@@ -899,6 +1013,11 @@ def main(argv: list[str] | None = None) -> int:
         pred_block = sweep_mod.prediction_block(spec, pts)
         if pred_block:
             out.write(pred_block + "\n")
+        # multi-level AET read-offs per schedule point (pluss/model/
+        # hierarchy.py: PLUSS_CACHE_LEVELS / _ASSOC / _POLICY)
+        hier_block = sweep_mod.hierarchy_block(spec, pts)
+        if hier_block:
+            out.write(hier_block + "\n")
     else:  # trace: dynamic replay (BASELINE config 5; bypasses CRI like the
         # reference's pluss_access path — see pluss/trace.py)
         if not args.file:

@@ -104,10 +104,64 @@ def nbd_dilate(thread_cnt: int, n: int) -> tuple[np.ndarray, np.ndarray]:
         ks = np.arange(0, ks.size * 2, dtype=np.float64)  # pragma: no cover
 
 
+@functools.lru_cache(maxsize=4096)
+def nbd_dilate_p(p: float, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Heterogeneous-rate NBD dilation: ``nbd_dilate`` generalized from
+    T identical threads (slot-ownership probability 1/T) to an arbitrary
+    ownership probability ``p`` in (0, 1] — the share of the interleaved
+    access stream this thread owns when K co-scheduled workloads with
+    different access rates compete for one cache (the r15 co-tenancy
+    composition, :mod:`pluss.analysis.interference`).
+
+    ``p = 1/T`` reproduces ``nbd_dilate(T, n)`` exactly: the cutoff
+    ``n >= NBD_CUTOFF_COEF * (1 - p)`` equals the homogeneous
+    ``NBD_CUTOFF_COEF * (T-1)/T`` and the point mass ``round(n / p)``
+    equals ``T * n``.  Same mass-cut accumulation, same pmf
+    parameterization, same frozen memoized arrays.
+    """
+    if p >= 1.0:
+        keys = np.array([n], np.int64)
+        pmf = np.array([1.0])
+        keys.setflags(write=False)
+        pmf.setflags(write=False)
+        return keys, pmf
+    if n >= NBD_CUTOFF_COEF * (1.0 - p):
+        keys = np.array([int(round(n / p))], np.int64)
+        pmf = np.array([1.0])
+        keys.setflags(write=False)
+        pmf.setflags(write=False)
+        return keys, pmf
+    r = float(n)
+    block = max(64, int(n * (1.0 - p) / p * 2) + 64)
+    ks = np.arange(0, block, dtype=np.float64)
+    while True:
+        pmf = np.exp(
+            _gammaln(ks + r) - _gammaln(ks + 1.0) - _gammaln(r)
+            + r * math.log(p) + ks * math.log1p(-p)
+        )
+        cum = np.cumsum(pmf)
+        over = np.nonzero(cum > NBD_MASS_CUT)[0]
+        if over.size:
+            stop = int(over[0]) + 1  # include the crossing term
+            keys = np.arange(stop, dtype=np.int64) + n
+            pmf = pmf[:stop]
+            keys.setflags(write=False)
+            pmf.setflags(write=False)
+            return keys, pmf
+        ks = np.arange(0, ks.size * 2, dtype=np.float64)  # pragma: no cover
+
+
 def noshare_distribute(noshare: list[Histogram], rihist: Histogram,
                        thread_cnt: int) -> None:
-    """``_pluss_cri_noshare_distribute`` (utils.rs:307-344)."""
-    for k, v in merge(noshare).items():
+    """``_pluss_cri_noshare_distribute`` (utils.rs:307-344).
+
+    Keys are consumed in SORTED order: the merged dict's insertion order
+    varies with the producer (engine device-merge vs static derivation),
+    and float accumulation into ``rihist`` is order-sensitive at the ulp
+    level.  Sorting makes the composed histogram a pure function of the
+    histogram CONTENTS, which is what lets ``pluss predict --check`` pin
+    bit-identical MRCs instead of epsilon-bounded ones."""
+    for k, v in sorted(merge(noshare).items()):
         if k < 0:
             histogram_update(rihist, k, v)
             continue
@@ -230,14 +284,19 @@ def racetrack(share: list[Histogram], rihist: Histogram, thread_cnt: int) -> Non
                 m[r] = m.get(r, 0.0) + c
     cut = NBD_CUTOFF_COEF * (thread_cnt - 1) / thread_cnt \
         if thread_cnt > 1 else 0.0
-    for n_key, hist in merged.items():
+    # sorted n_keys and raw keys: same determinism contract as
+    # noshare_distribute — the composed histogram depends only on the
+    # histogram contents, never on producer dict insertion order
+    for n_key in sorted(merged):
+        hist = merged[n_key]
         n = float(n_key)
         if thread_cnt <= 1:
-            for r, c in hist.items():
-                histogram_update(rihist, r, c)
+            for r in sorted(hist):
+                histogram_update(rihist, r, hist[r])
             continue
-        rs = np.fromiter(hist.keys(), np.int64, len(hist))
-        cs = np.fromiter(hist.values(), np.float64, len(hist))
+        items = sorted(hist.items())
+        rs = np.fromiter((k for k, _ in items), np.int64, len(items))
+        cs = np.fromiter((v for _, v in items), np.float64, len(items))
         big = rs >= cut
         ri_parts = [thread_cnt * rs[big]]
         w_parts = [cs[big]]

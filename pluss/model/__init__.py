@@ -1,0 +1,15 @@
+"""Cache-model layer: AET-exact hierarchy read-offs (r15).
+
+:mod:`pluss.model.hierarchy` turns one reuse-interval histogram into
+multi-level / set-associative / non-LRU miss-ratio read-offs; the
+cross-nest co-tenancy composition that feeds it heterogeneous streams
+lives in :mod:`pluss.analysis.interference`.
+"""
+
+from pluss.model.hierarchy import (  # noqa: F401
+    HierarchyConfig,
+    aet_plateau,
+    hierarchy_doc,
+    level_readoffs,
+    render_hierarchy,
+)

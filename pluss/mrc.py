@@ -43,17 +43,24 @@ def survival(rihist: dict) -> tuple[np.ndarray, np.ndarray]:
     return ks, vs
 
 
-def aet_mrc(rihist: dict, cfg: SamplerConfig = DEFAULT) -> np.ndarray:
-    """Miss ratio per cache size c = 0..min(max_key, cache entries).
+def aet_times(rihist: dict, cfg: SamplerConfig = DEFAULT) -> np.ndarray:
+    """AET eviction times t*(c) for c = 0..min(max_key, cache entries).
 
-    Returns ``mrc`` with ``mrc[c]`` = the value the reference stores in
-    ``_MRC[c]`` (pluss_utils.h:786-802).  Empty histogram -> one-point [1.0].
+    ``t*(c)`` is the first time cursor position with cumulative survival
+    ``S(t) >= c`` — the Average Eviction Time of a cache of size c under
+    this reuse distribution.  This is the quantity the reference's
+    ``pluss_AET`` sweep tracks implicitly; exposing it first-class is
+    what makes the r15 hierarchy/co-tenancy read-offs possible: a
+    co-runner's degraded miss ratio is ITS survival evaluated at the
+    MERGED stream's eviction times (:mod:`pluss.model.hierarchy`).
+
+    Empty / cold-only histograms return the single time [0].
     """
     if not rihist:
-        return np.array([1.0])
+        return np.array([0], np.int64)
     max_rt = max(rihist.keys())
     if max_rt < 0:
-        return np.array([1.0])
+        return np.array([0], np.int64)
     ks, vs = survival(rihist)
 
     # segments [ks[j], ks[j+1]-1] with constant step value vs[j]; the cursor
@@ -73,10 +80,35 @@ def aet_mrc(rihist: dict, cfg: SamplerConfig = DEFAULT) -> np.ndarray:
     need = np.maximum(cs - prev_cum, 0.0)
     steps = np.ceil(need / np.where(v > 0, v, 1.0))
     t = ks[j] + np.maximum(steps - 1, 0).astype(np.int64)
-    t = np.minimum(t, max_rt)
-    # MRC[c] = P at the largest key <= t* (the cursor's prev_t)
-    seg_of_t = np.searchsorted(ks, t, side="right") - 1
+    return np.minimum(t, max_rt)
+
+
+def survival_at(rihist: dict, t: np.ndarray) -> np.ndarray:
+    """P(reuse > t) of ``rihist``'s survival step function at times ``t``.
+
+    ``survival_at(h, aet_times(h, cfg))`` IS ``aet_mrc(h, cfg)`` — same
+    arrays, same lookups, bit-identical.  With a DIFFERENT histogram it
+    reads one workload's miss ratio off another (merged) stream's
+    eviction clock, the co-tenancy composition read-off."""
+    ks, vs = survival(rihist)
+    # MRC[c] = P at the largest key <= t* (the cursor's prev_t); ks always
+    # contains 0 (survival forces P[0]), so the clamp only guards t < 0
+    seg_of_t = np.maximum(np.searchsorted(ks, t, side="right") - 1, 0)
     return vs[seg_of_t]
+
+
+def aet_mrc(rihist: dict, cfg: SamplerConfig = DEFAULT) -> np.ndarray:
+    """Miss ratio per cache size c = 0..min(max_key, cache entries).
+
+    Returns ``mrc`` with ``mrc[c]`` = the value the reference stores in
+    ``_MRC[c]`` (pluss_utils.h:786-802).  Empty histogram -> one-point [1.0].
+    """
+    if not rihist:
+        return np.array([1.0])
+    max_rt = max(rihist.keys())
+    if max_rt < 0:
+        return np.array([1.0])
+    return survival_at(rihist, aet_times(rihist, cfg))
 
 
 def plateau_of(rihist: dict, mrc: np.ndarray) -> int | None:

@@ -499,6 +499,30 @@ def hardening_breakdown(counters: dict[str, float],
     return lines
 
 
+def interference_breakdown(counters: dict[str, float],
+                           gauges: dict[str, float]) -> list[str]:
+    """The co-tenancy interference block (r15): advisory stamps the
+    serving path attached to responses dispatched with other workloads
+    queued behind them, how many crossed the severe (PL801) bar, and the
+    last observed miss-ratio inflation.  Empty when no dispatch ever had
+    a co-tenant (solo traffic prints nothing here)."""
+    adv = counters.get("serve.interference.advisories")
+    errs = counters.get("serve.interference.errors")
+    if not adv and not errs:
+        return []
+    lines = ["co-tenancy interference:"]
+    sev = counters.get("serve.interference.severe", 0.0)
+    lines.append(f"  {'advisories (of them severe)':<28} "
+                 f"{int(adv or 0):>9}  ({int(sev)} PL801)")
+    infl = gauges.get("serve.interference.last_inflation")
+    if infl is not None:
+        lines.append(f"  {'last inflation':<28} {_fmt_val(infl):>9}")
+    if errs:
+        lines.append(f"  {'advisory errors (no stamp)':<28} "
+                     f"{int(errs):>9}")
+    return lines
+
+
 def render(records: list[dict], out) -> None:
     """Write the human report for one loaded stream."""
     n_spans = sum(1 for r in records if r.get("ev") == "span")
@@ -551,6 +575,9 @@ def render(records: list[dict], out) -> None:
     hblock = hardening_breakdown(counters, gauges)
     if hblock:
         out.write("\n".join(hblock) + "\n")
+    iblock = interference_breakdown(counters, gauges)
+    if iblock:
+        out.write("\n".join(iblock) + "\n")
 
 
 def main(path: str, out, err, check: bool = False) -> int:

@@ -281,6 +281,27 @@ class AdmissionQueue:
             self._cv.wait(timeout)
             return bool(self._count)
 
+    def co_tenant_specs(self, key: tuple, limit: int = 4
+                        ) -> list[tuple[tuple, object, object]]:
+        """The co-tenants a dispatch for ``key`` would share the device
+        cache with: up to ``limit`` queued spec requests with DISTINCT
+        non-matching batch keys, as ``(batch_key, spec, cfg)`` triples.
+        Feeds the interference advisory (r15) — a read-only peek; nothing
+        is removed from the queue."""
+        out: dict[tuple, tuple] = {}
+        with self._cv:
+            for dq in self._q.values():
+                for r in dq:
+                    if r.kind != "spec" or r.spec is None:
+                        continue
+                    k = r.batch_key()
+                    if k == key or k in out:
+                        continue
+                    out[k] = (k, r.spec, r.cfg)
+                    if len(out) >= limit:
+                        return list(out.values())
+        return list(out.values())
+
     def has_other_work(self, key: tuple) -> bool:
         """Whether a NON-matching request is queued — the adaptive batch
         window closes early when holding the dispatch would add latency

@@ -281,6 +281,15 @@ class Server:
         # readiness: set immediately when no --warm is configured, else
         # at the end of the warm loop
         self._warm_done = threading.Event()
+        # interference advisory (r15): co-tenancy stamps are computed
+        # from the static composition once per (dispatch key, co-tenant
+        # key set) and cached — pure host math, but not free
+        from pluss.utils.envknob import env_choice
+
+        self._interference_on = env_choice(
+            "PLUSS_SERVE_INTERFERENCE", "on", ("on", "off")) == "on"
+        self._advisory_cache: dict[tuple, dict | None] = {}
+        self._advisory_lock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -1052,6 +1061,7 @@ class Server:
             res.degradations = tuple(res.degradations) + tuple(stamp)
         if on_success is not None:
             on_success()
+        advisory = self._interference_advisory(lead)
         k = len(batch)
         for req in batch:
             if req.expired():
@@ -1068,7 +1078,83 @@ class Server:
             payload["refs"] = int(view.max_iteration_count)
             if view.degradations:
                 payload["degradations"] = list(view.degradations)
+            if advisory is not None:
+                # ADDITIVE stamp: the result fields above are untouched,
+                # so coalesced responses stay bit-identical to solo runs
+                payload["interference"] = advisory
             self._respond_ok(req, payload, k)
+
+    def _interference_advisory(self, lead: Request) -> dict | None:
+        """Co-tenancy advisory for a spec dispatch (r15): when OTHER
+        workloads are queued behind this dispatch, the static cross-nest
+        composition (:mod:`pluss.analysis.interference`) prices this
+        workload's miss-ratio inflation under co-scheduling and stamps a
+        typed verdict (PL801 severe / PL802 benign / PL803 outside the
+        composition contract) onto the response.  Advisory only: it never
+        reorders, sheds, or alters results — and never fails a dispatch
+        (any internal error degrades to no stamp, counted)."""
+        if not self._interference_on or lead.spec is None:
+            return None
+        try:
+            key = lead.batch_key()
+            co = self.queue.co_tenant_specs(key)
+            if not co:
+                return None
+            cache_key = (key, tuple(sorted(k for k, _, _ in co)))
+            with self._advisory_lock:
+                if cache_key in self._advisory_cache:
+                    adv = self._advisory_cache[cache_key]
+                else:
+                    adv = self._compute_advisory(lead, co)
+                    if len(self._advisory_cache) >= 256:
+                        # bounded memo: arbitrary co-tenant key sets must
+                        # not grow this for the daemon's whole life
+                        self._advisory_cache.clear()
+                    self._advisory_cache[cache_key] = adv
+            if adv is not None:
+                obs.counter_add("serve.interference.advisories")
+                if adv["code"] == "PL801":
+                    obs.counter_add("serve.interference.severe")
+                obs.gauge_set("serve.interference.last_inflation",
+                              float(adv.get("inflation", 0.0)))
+            return adv
+        except Exception:  # noqa: BLE001 — advisory must never fail serving
+            obs.counter_add("serve.interference.errors")
+            return None
+
+    @staticmethod
+    def _compute_advisory(lead: Request, co: list[tuple]) -> dict | None:
+        from pluss.analysis import interference as itf
+        from pluss.analysis import ri as ri_mod
+
+        co_names = sorted({spec.name for _, spec, _ in co})
+        inputs: list[itf.WorkloadInput] = []
+        for spec, cfg in [(lead.spec, lead.cfg)] + [(s, c)
+                                                    for _, s, c in co]:
+            pred = ri_mod.derive(spec, cfg)
+            if not pred.derivable or pred.accesses <= 0:
+                if spec is lead.spec:
+                    # the advisory is ABOUT the lead: underivable lead
+                    # means the pair is outside the composition contract
+                    return {"code": "PL803", "co_tenants": co_names,
+                            "detail": "workload outside the composition "
+                                      "model's contract"}
+                continue
+            inputs.append(itf.WorkloadInput(
+                spec.name, pred.noshare, pred.share, cfg,
+                float(pred.accesses), int(pred.accesses), spec=spec))
+        if len(inputs) < 2:
+            return {"code": "PL803", "co_tenants": co_names,
+                    "detail": "co-tenants outside the composition "
+                              "model's contract"}
+        rep = itf.compose(inputs, lead.cfg)
+        v = rep.verdicts[0]   # the lead workload's verdict
+        return {"code": v.code, "co_tenants": co_names,
+                "inflation": round(v.inflation, 9),
+                "solo_miss_ratio": round(v.solo_mr, 9),
+                "degraded_miss_ratio": round(v.degraded_mr, 9),
+                "threshold": rep.threshold,
+                "cache_kb": rep.cache_kb}
 
     def _execute_trace(self, batch: list[Request],
                        on_success=None) -> None:

@@ -378,6 +378,39 @@ def prediction_block(spec: LoopNestSpec,
     return "static prediction (PL7xx):\n" + "\n".join(lines)
 
 
+def hierarchy_block(spec: LoopNestSpec,
+                    points: Iterable[SweepPoint]) -> str:
+    """AET-exact hierarchy read-offs for the sweep report: per swept
+    config, every declared cache level's miss ratio priced off the same
+    derived histogram (:mod:`pluss.model.hierarchy`; PLUSS_CACHE_LEVELS
+    / PLUSS_CACHE_ASSOC / PLUSS_CACHE_POLICY declare the hierarchy).
+    Schedules the predictor refuses are skipped, not approximated."""
+    from pluss.analysis import ri
+    from pluss.model import hierarchy as hier_mod
+
+    points = list(points)
+    if not points:
+        return ""
+    hier = hier_mod.HierarchyConfig.from_env()
+    lines = []
+    for p in points:
+        rep = ri.predict(spec, p.cfg)
+        if rep.rihist is None:
+            continue
+        doc = hier_mod.hierarchy_doc(rep.rihist, p.cfg, hier)
+        levels = " | ".join(
+            f"{lv['size_kb']}KB {lv['miss_ratio']:.4g}"
+            for lv in doc["levels"])
+        plat = f" plateau c={doc['plateau_c']}" \
+            if doc["plateau_c"] is not None else ""
+        lines.append(f"  threads={p.cfg.thread_num} "
+                     f"chunk={p.cfg.chunk_size}: {levels} "
+                     f"[{doc['levels'][0]['model']}]{plat}")
+    if not lines:
+        return ""
+    return "hierarchy:\n" + "\n".join(lines)
+
+
 def carried_levels(spec: LoopNestSpec) -> str:
     """The static analyzer's PL303 carried-level classifications as a
     compact report block (ROADMAP PR-1 follow-up): one line per annotated

@@ -33,6 +33,36 @@ def env_choice(name: str, default: str, choices: tuple[str, ...]) -> str:
                          tuple(choices))
 
 
+def env_int_list(name: str, default: tuple[int, ...],
+                 minimum: int = 1) -> tuple[int, ...]:
+    """Comma-separated ascending int list (e.g. PLUSS_CACHE_LEVELS): any
+    malformed element, out-of-range value, or non-ascending order warns
+    once and falls back to the WHOLE default — a partially-applied
+    hierarchy would silently model a cache that does not exist."""
+    return _parse_int_list(name, os.environ.get(name, ""), tuple(default),
+                           minimum)
+
+
+@functools.lru_cache(maxsize=64)
+def _parse_int_list(name: str, raw: str, default: tuple[int, ...],
+                    minimum: int) -> tuple[int, ...]:
+    if not raw.strip():
+        return default
+    try:
+        vs = tuple(int(x) for x in raw.split(","))
+    except ValueError:
+        print(f"pluss: ignoring malformed {name}={raw!r}; using the "
+              f"default {','.join(map(str, default))}", file=sys.stderr)
+        return default
+    if not vs or any(v < minimum for v in vs) \
+            or any(a >= b for a, b in zip(vs, vs[1:])):
+        print(f"pluss: ignoring out-of-range {name}={raw!r} (elements "
+              f"must be >= {minimum} and strictly ascending); using the "
+              f"default {','.join(map(str, default))}", file=sys.stderr)
+        return default
+    return vs
+
+
 @functools.lru_cache(maxsize=64)
 def _parse_choice(name: str, raw: str, default: str,
                   choices: tuple[str, ...]) -> str:

@@ -37,6 +37,22 @@ assert not errs, errs; print('predict SARIF smoke: valid,', \
     len(doc['runs'][0]['results']), 'result(s)')" "$PLUSS_PREDICT_SARIF" 1>&2
 rm -f "$PLUSS_PREDICT_SARIF"
 
+# co-tenancy composition gate (tier-1, r15): the cross-nest CRI
+# composition (pluss/analysis/interference.py) on the gemm+syrk pair at
+# n=16, --check pinning each workload's composed degraded MRC against
+# the interleaved schedule-simulation oracle (exact LRU stack distances
+# on the proportional-fair merged stream).  Pure host math — no device.
+# The SARIF export (PL801/PL802/PL803 findings) is smoke-parsed through
+# the structural validator like the predict gate above.
+PLUSS_COT_SARIF=$(mktemp /tmp/pluss_cot_XXXX.sarif)
+JAX_PLATFORMS=cpu python -m pluss.cli cotenancy gemm+syrk --n 16 --check \
+  --sarif "$PLUSS_COT_SARIF" 1>&2
+python -c "import json, sys; from pluss.analysis import sarif; \
+doc = json.load(open(sys.argv[1])); errs = sarif.validate(doc); \
+assert not errs, errs; print('cotenancy SARIF smoke: valid,', \
+    len(doc['runs'][0]['results']), 'result(s)')" "$PLUSS_COT_SARIF" 1>&2
+rm -f "$PLUSS_COT_SARIF"
+
 # frontend import smoke (tier-1): the checked-in gemm.ppcg_omp-shaped C
 # source → tokenizer → recursive-descent parse → lower → share-span
 # derivation → PR-1 analyzer gate → engine run, with --check-model

@@ -1,0 +1,428 @@
+"""r15: cross-nest CRI composition, AET-exact hierarchy read-offs, the
+`pluss cotenancy` surface, and the serve-side interference advisory.
+
+The composition tests pin against the interleaved schedule-simulation
+oracle (the same three-pin contract `pluss cotenancy --check` enforces);
+the identity tests pin the load-bearing refactors bit-exactly: the AET
+factoring (`aet_mrc == survival_at(aet_times)`), the heterogeneous NBD
+dilation collapsing to the homogeneous one at p = 1/T, and the sorted
+deterministic accumulation that makes equal histograms compose to
+bit-identical curves regardless of input dict/list order.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (CPU platform + x64)
+from pluss import cli, cri, mrc
+from pluss.analysis import interference as itf
+from pluss.analysis import ri as ri_mod
+from pluss.analysis import sarif
+from pluss.config import SamplerConfig
+from pluss.model import hierarchy as hier
+from pluss.models import REGISTRY
+from pluss.serve import Client, ServeConfig, Server
+
+
+def derived_hist(model: str, n: int = 16,
+                 cfg: SamplerConfig | None = None):
+    cfg = cfg or SamplerConfig(thread_num=2, chunk_size=2)
+    pred = ri_mod.derive(REGISTRY[model](n), cfg)
+    assert pred.derivable
+    return cri.distribute(pred.noshare, pred.share, cfg.thread_num), cfg
+
+
+# ---------------------------------------------------------------------------
+# composition vs the interleaved schedule-simulation oracle
+
+
+ORACLE_PAIRS = [("gemm", "syrk"), ("gemm", "bicg"), ("syrk", "bicg"),
+                ("syrk", "mvt"), ("bicg", "mvt"), ("gemm", "atax")]
+
+
+@pytest.mark.parametrize("threads", [1, 2, 4])
+@pytest.mark.parametrize("a,b", ORACLE_PAIRS)
+def test_composition_tracks_oracle(a, b, threads):
+    cfg = SamplerConfig(thread_num=threads, chunk_size=max(1, threads))
+    inputs, refusals = itf.from_models([a, b], cfg, 16)
+    assert not refusals and len(inputs) == 2
+    rep = itf.compose(inputs, cfg)
+    ok, doc = itf.check_against_oracle(rep, inputs, cfg)
+    assert ok, doc["per_workload"]
+    # oracle curves are per-workload and cover both tenants
+    assert {w["workload"] for w in doc["per_workload"]} == {a, b}
+
+
+def test_oracle_requires_specs():
+    cfg = SamplerConfig(thread_num=2, chunk_size=2)
+    inputs, _ = itf.from_models(["gemm", "syrk"], cfg, 16)
+    stripped = [itf.WorkloadInput(w.name, w.noshare, w.share, w.cfg,
+                                  w.rate, w.accesses, spec=None)
+                for w in inputs]
+    with pytest.raises(ValueError, match="oracle needs specs"):
+        itf.oracle_mrcs(stripped, cfg)
+
+
+# ---------------------------------------------------------------------------
+# bit-exact identities behind the composition
+
+
+@pytest.mark.parametrize("model", ["gemm", "syrk", "mvt"])
+def test_aet_mrc_is_survival_at_aet_times(model):
+    """The AET factoring: the curve `aet_mrc` returns IS the survival
+    function read at the eviction times — bit-identical, not epsilon."""
+    h, cfg = derived_hist(model)
+    curve = mrc.aet_mrc(h, cfg)
+    again = mrc.survival_at(h, mrc.aet_times(h, cfg))
+    assert np.array_equal(curve, again)
+
+
+@pytest.mark.parametrize("threads", [1, 2, 3, 4, 8])
+def test_nbd_dilate_p_collapses_to_homogeneous(threads):
+    """`nbd_dilate_p(1/T, n)` must reproduce `nbd_dilate(T, n)` exactly:
+    same keys, same pmf, to the bit (the heterogeneous dilation is a
+    strict generalization, not a reimplementation that drifts)."""
+    for n in (1, 2, 5, 17, 64, 1000, 100000):
+        k1, p1 = cri.nbd_dilate(threads, n)
+        k2, p2 = cri.nbd_dilate_p(1.0 / threads, n)
+        assert np.array_equal(k1, k2)
+        assert np.array_equal(p1, p2)
+
+
+def test_nbd_dilate_p_point_masses():
+    # p >= 1: the thread owns the whole stream — reuse unchanged
+    keys, pmf = cri.nbd_dilate_p(1.0, 37)
+    assert keys.tolist() == [37] and pmf.tolist() == [1.0]
+    # past the cutoff: deterministic dilation to round(n / p)
+    keys, pmf = cri.nbd_dilate_p(0.5, 10 ** 9)
+    assert keys.tolist() == [2 * 10 ** 9] and pmf.tolist() == [1.0]
+
+
+@pytest.mark.parametrize("model", ["gemm", "syrk", "mvt"])
+def test_distribute_p_reproduces_solo_distribute(model):
+    """With a single workload at p = 1/T, the heterogeneous pass is the
+    solo CRI pass — bit-identical histograms."""
+    cfg = SamplerConfig(thread_num=4, chunk_size=4)
+    pred = ri_mod.derive(REGISTRY[model](16), cfg)
+    solo = cri.distribute(pred.noshare, pred.share, cfg.thread_num)
+    hetero = itf.distribute_p(pred.noshare, pred.share,
+                              1.0 / cfg.thread_num)
+    assert solo == hetero
+
+
+def test_distribute_deterministic_under_input_order():
+    """Sorted-key accumulation (r15): the composed histogram is a pure
+    function of histogram CONTENTS — reversing list order and dict
+    insertion order changes nothing, to the bit."""
+    cfg = SamplerConfig(thread_num=4, chunk_size=4)
+    pred = ri_mod.derive(REGISTRY["gemm"](16), cfg)
+    ns = [dict(reversed(list(h.items()))) for h in reversed(pred.noshare)]
+    sh = [{k: dict(reversed(list(v.items())))
+           for k, v in reversed(list(h.items()))} for h in
+          reversed(pred.share)]
+    base = cri.distribute(pred.noshare, pred.share, cfg.thread_num)
+    shuffled = cri.distribute(ns, sh, cfg.thread_num)
+    assert base == shuffled
+    base_p = itf.distribute_p(pred.noshare, pred.share, 0.25)
+    shuffled_p = itf.distribute_p(ns, sh, 0.25)
+    assert base_p == shuffled_p
+
+
+# ---------------------------------------------------------------------------
+# verdicts and typed refusals
+
+
+def test_forced_pl801_severe_verdict():
+    """A 1 KB cache under a gemm+syrk pair at n=32 is a genuinely
+    thrashing co-tenancy: gemm's verdict must be severe."""
+    cfg = SamplerConfig(thread_num=4, chunk_size=4, cache_kb=1)
+    rep = itf.analyze_models(["gemm", "syrk"], cfg, n=32)
+    codes = {v.name: v.code for v in rep.verdicts}
+    assert codes["gemm"] == "PL801"
+    v = next(v for v in rep.verdicts if v.name == "gemm")
+    assert v.inflation > rep.threshold
+    assert v.degraded_mr == pytest.approx(v.solo_mr + v.inflation)
+    assert any(d.code == "PL801" for d in rep.diagnostics)
+
+
+def test_benign_pl802_at_default_cache():
+    rep = itf.analyze_models(["gemm", "syrk"], SamplerConfig(), n=16)
+    assert [v.code for v in rep.verdicts] == ["PL802", "PL802"]
+    assert not rep.refused
+    # ownership shares: equal-thread workloads split by access rate
+    assert sum(v.p for v in rep.verdicts) < 1.0 + 1e-12
+    doc = rep.doc()
+    assert doc["workloads"] == ["gemm", "syrk"]
+    assert len(doc["degraded_mrc"]) == 2
+
+
+def test_pl803_nonpositive_rate_refused():
+    cfg = SamplerConfig(thread_num=2, chunk_size=2)
+    rep = itf.analyze_models(["gemm", "syrk"], cfg, 16, rates=[0.0, 1.0])
+    assert rep.refused
+    assert [d.code for d in rep.diagnostics] == ["PL803"]
+    assert rep.verdicts == []  # only one composable survivor -> refusal
+
+
+def test_pl803_pure_refusal_report():
+    cfg = SamplerConfig(thread_num=2, chunk_size=2)
+    rep = itf.analyze_models(["gemm", "syrk"], cfg, 16, rates=[0.0, 0.0])
+    assert rep.refused and rep.verdicts == []
+    assert [d.code for d in rep.diagnostics] == ["PL803", "PL803"]
+
+
+def test_compose_needs_two_workloads():
+    cfg = SamplerConfig(thread_num=2, chunk_size=2)
+    inputs, _ = itf.from_models(["gemm"], cfg, 16)
+    with pytest.raises(ValueError, match=">= 2 workloads"):
+        itf.compose(inputs, cfg)
+
+
+def test_interference_threshold_knob(monkeypatch):
+    monkeypatch.setenv("PLUSS_INTERFERENCE_THRESHOLD", "0.5")
+    assert itf.interference_threshold() == 0.5
+    # warn-and-default on garbage, never crash
+    monkeypatch.setenv("PLUSS_INTERFERENCE_THRESHOLD", "not-a-float")
+    assert itf.interference_threshold() == itf.DEFAULT_THRESHOLD
+
+
+# ---------------------------------------------------------------------------
+# AET-exact hierarchy model
+
+
+@pytest.mark.parametrize("model", ["gemm", "syrk", "mvt"])
+def test_hierarchy_assoc_zero_is_exact_lru(model):
+    h, cfg = derived_hist(model)
+    curve = mrc.aet_mrc(h, cfg)
+    entries = hier.entries_of_kb(32)
+    exact = float(curve[min(entries, len(curve) - 1)])
+    assert hier.assoc_miss_ratio(h, entries, 0, cfg) == exact
+    # assoc >= entries degenerates to fully associative: same exact number
+    assert hier.assoc_miss_ratio(h, entries, entries + 1, cfg) == exact
+
+
+def test_hierarchy_assoc_never_beats_full_assoc():
+    """Finite associativity only adds conflict misses on top of LRU."""
+    h, cfg = derived_hist("mvt")
+    entries = hier.entries_of_kb(32)
+    full = hier.assoc_miss_ratio(h, entries, 0, cfg)
+    for ways in (1, 2, 8):
+        assert hier.assoc_miss_ratio(h, entries, ways, cfg) >= full - 1e-12
+
+
+@pytest.mark.parametrize("model", ["gemm", "syrk", "mvt"])
+def test_hierarchy_random_fixed_point_sane(model):
+    h, cfg = derived_hist(model)
+    total = float(sum(h.values()))
+    floor = float(h.get(-1, 0.0)) / total
+    m = hier.random_miss_ratio(h, hier.entries_of_kb(32))
+    assert floor - 1e-12 <= m <= 1.0
+
+
+def test_hierarchy_levels_monotone_and_local():
+    h, cfg = derived_hist("gemm")
+    levels = hier.level_readoffs(h, cfg)
+    assert [lv["size_kb"] for lv in levels] == list(hier.DEFAULT_LEVELS_KB)
+    mrs = [lv["miss_ratio"] for lv in levels]
+    assert all(a >= b - 1e-15 for a, b in zip(mrs, mrs[1:]))
+    assert all(0.0 <= lv["local_miss_ratio"] <= 1.0 for lv in levels)
+    assert all(lv["model"] == "aet-lru-exact" for lv in levels)
+
+
+def test_hierarchy_plateau_is_exact():
+    """A non-None plateau names the first cache size at the compulsory
+    floor with float EQUALITY — the point the PR-3 bracket only bounded."""
+    h, cfg = derived_hist("gemm")
+    plateau, floor = hier.aet_plateau(h, cfg)
+    assert plateau is not None
+    curve = mrc.aet_mrc(h, cfg)
+    assert float(curve[plateau]) == floor
+    assert float(curve[plateau - 1]) > floor
+
+
+def test_hierarchy_doc_and_render():
+    h, cfg = derived_hist("syrk")
+    doc = hier.hierarchy_doc(h, cfg)
+    assert set(doc) == {"levels", "assoc", "policy", "plateau_c",
+                        "compulsory_floor"}
+    lines = hier.render_hierarchy(doc)
+    assert lines[0] == "hierarchy:"
+    assert len(lines) == len(doc["levels"]) + 2  # header + plateau line
+    assert any("plateau" in ln for ln in lines)
+
+
+def test_cache_levels_knob_warn_and_default(monkeypatch):
+    # distinct raw strings: the envknob parse is memoized on (name, raw)
+    monkeypatch.setenv("PLUSS_CACHE_LEVELS", "banana,7kb")
+    assert hier.HierarchyConfig.from_env().levels_kb == \
+        hier.DEFAULT_LEVELS_KB
+    monkeypatch.setenv("PLUSS_CACHE_LEVELS", "8,64")
+    assert hier.HierarchyConfig.from_env().levels_kb == (8, 64)
+    monkeypatch.setenv("PLUSS_CACHE_POLICY", "fifo")  # unknown -> default
+    assert hier.HierarchyConfig.from_env().policy == "lru"
+    monkeypatch.setenv("PLUSS_CACHE_ASSOC", "4")
+    assert hier.HierarchyConfig.from_env().assoc == 4
+
+
+def test_hierarchy_random_policy_readoffs(monkeypatch):
+    monkeypatch.setenv("PLUSS_CACHE_POLICY", "random")
+    h, cfg = derived_hist("mvt")
+    levels = hier.level_readoffs(h, cfg)
+    assert all(lv["model"] == "aet-random" for lv in levels)
+    assert all(0.0 <= lv["miss_ratio"] <= 1.0 for lv in levels)
+
+
+# ---------------------------------------------------------------------------
+# `pluss cotenancy` CLI
+
+
+def test_cli_cotenancy_text(capsys):
+    rc = cli.main(["cotenancy", "gemm+syrk", "--n", "16"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "gemm: solo" in out and "syrk: solo" in out
+    assert "pluss cotenancy: 2 workload(s)" in out
+
+
+def test_cli_cotenancy_json(capsys):
+    rc = cli.main(["cotenancy", "gemm+syrk", "--n", "16", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["workloads"] == ["gemm", "syrk"]
+    assert {v["code"] for v in doc["verdicts"]} <= {"PL801", "PL802"}
+    assert doc["schedule"]
+
+
+def test_cli_cotenancy_check_and_sarif(tmp_path, capsys):
+    path = tmp_path / "cot.sarif"
+    rc = cli.main(["cotenancy", "gemm+syrk", "--n", "16", "--check",
+                   "--sarif", str(path)])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "pluss cotenancy: gemm: ok" in err
+    assert "pluss cotenancy: syrk: ok" in err
+    doc = json.loads(path.read_text())
+    assert sarif.validate(doc) == []
+
+
+@pytest.mark.parametrize("target", ["gemm", "gemm+nosuchmodel", "gemm+"])
+def test_cli_cotenancy_usage_errors(target, capsys):
+    """Malformed target lists are typed usage errors, not tracebacks."""
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["cotenancy", target, "--n", "16"])
+    assert exc.value.code == 2
+    assert "pluss" in capsys.readouterr().err
+
+
+def test_cli_cotenancy_pl801_exit_code(capsys):
+    """Severe interference still exits 0 (it is a verdict, not an
+    error); the PL801 line and summary must name it."""
+    rc = cli.main(["cotenancy", "gemm+syrk", "--n", "32",
+                   "--threads", "4", "--chunk", "4", "--cache-kb", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[PL801]" in out and "1 severe" in out
+
+
+# ---------------------------------------------------------------------------
+# serve-side interference advisory
+
+
+@pytest.fixture
+def server_factory(tmp_path):
+    servers = []
+    counter = [0]
+
+    def build(**cfg_kw) -> Server:
+        counter[0] += 1
+        sock = str(tmp_path / f"s{counter[0]}.sock")
+        srv = Server(socket_path=sock, config=ServeConfig(**cfg_kw))
+        srv.start()
+        servers.append(srv)
+        return srv
+
+    yield build
+    for srv in servers:
+        srv.shutdown(drain_timeout_s=30)
+
+
+GEMM_REQ = {"model": "gemm", "n": 32, "threads": 4, "chunk": 4,
+            "cache_kb": 1, "output": "both"}
+SYRK_REQ = {"model": "syrk", "n": 32, "threads": 4, "chunk": 4,
+            "cache_kb": 1, "output": "both"}
+
+
+def test_serve_advisory_forced_pl801(server_factory, tmp_path):
+    """A queued co-tenant at a thrashing cache size stamps the lead
+    response with a severe advisory — and the results stay bit-identical
+    to the solo run (advisory only, never a behavior change)."""
+    from pluss import obs
+
+    obs.configure(str(tmp_path / "tel.jsonl"))
+    try:
+        srv = server_factory(max_batch=4, max_delay_ms=5, max_queue=32)
+        with Client(srv.socket_path) as c:
+            solo = c.request(dict(GEMM_REQ))
+            assert solo["ok"] and "interference" not in solo
+            # hold the device loop so gemm+syrk stack up in admission:
+            # when gemm dispatches, syrk is still queued -> a visible
+            # co-tenant
+            hold = c.send({"sleep_ms": 400})
+            time.sleep(0.1)
+            gid = c.send(dict(GEMM_REQ))
+            sid = c.send(dict(SYRK_REQ))
+            g = c.recv(gid)
+            s = c.recv(sid)
+            c.recv(hold)
+            st = c.request({"op": "stats"})
+    finally:
+        obs.shutdown()
+    assert g["ok"] and s["ok"]
+    adv = g.get("interference")
+    assert adv is not None, "lead dispatch saw a queued co-tenant"
+    assert adv["code"] == "PL801"
+    # co-tenant named by its spec (registry specs carry the size: syrk32)
+    assert len(adv["co_tenants"]) == 1
+    assert adv["co_tenants"][0].startswith("syrk")
+    assert adv["inflation"] > adv["threshold"]
+    assert adv["degraded_miss_ratio"] > adv["solo_miss_ratio"]
+    assert adv["cache_kb"] == 1
+    # ADDITIVE stamp: result fields bit-identical to the solo response
+    assert g["mrc"] == solo["mrc"]
+    assert g["histogram"] == solo["histogram"]
+    assert st["counters"].get("serve.interference.advisories", 0) >= 1
+    assert st["counters"].get("serve.interference.severe", 0) >= 1
+    assert "serve.interference.last_inflation" in st["gauges"]
+
+
+def test_stats_interference_breakdown():
+    from pluss.obs import stats as stats_mod
+
+    lines = stats_mod.interference_breakdown(
+        {"serve.interference.advisories": 3.0,
+         "serve.interference.severe": 1.0,
+         "serve.interference.errors": 2.0},
+        {"serve.interference.last_inflation": 0.114})
+    assert lines[0] == "co-tenancy interference:"
+    assert any("(1 PL801)" in ln for ln in lines)
+    assert any("last inflation" in ln for ln in lines)
+    assert any("advisory errors" in ln for ln in lines)
+    # absent without serve.interference counters: no empty block
+    assert stats_mod.interference_breakdown({}, {}) == []
+
+
+def test_serve_advisory_knob_off(server_factory, monkeypatch):
+    monkeypatch.setenv("PLUSS_SERVE_INTERFERENCE", "off")
+    srv = server_factory(max_batch=4, max_delay_ms=5, max_queue=32)
+    with Client(srv.socket_path) as c:
+        hold = c.send({"sleep_ms": 300})
+        time.sleep(0.1)
+        gid = c.send(dict(GEMM_REQ))
+        sid = c.send(dict(SYRK_REQ))
+        g = c.recv(gid)
+        c.recv(sid)
+        c.recv(hold)
+    assert g["ok"] and "interference" not in g
