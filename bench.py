@@ -1094,6 +1094,144 @@ def bench_cotenancy(n: int = 16) -> None:
     }), flush=True)
 
 
+def bench_tune(n: int = 128) -> None:
+    """Schedule-tuning headline (round r16 on): wall time of the full
+    proof-carrying `pluss tune` search (pluss/analysis/tune.py) on gemm
+    over the default (threads, chunk) space — footprint floors, dominance
+    pruning, per-fiber derivation, hierarchy read-offs, verdict — with
+    the engine's dispatch counter witnessing that the whole search is
+    host math (zero device dispatches, by construction and by check)."""
+    from pluss import engine
+    from pluss.analysis import tune as tune_mod
+    from pluss.models import gemm
+
+    spec = gemm(n)
+    d0 = engine.DEVICE_DISPATCHES
+    t0 = time.perf_counter()
+    rep = tune_mod.tune(spec)
+    dt = time.perf_counter() - t0
+    dispatched = engine.DEVICE_DISPATCHES - d0
+    if dispatched:
+        raise RuntimeError(
+            f"tune search touched the device: {dispatched} dispatch(es)")
+    log(f"bench: tune gemm{n} over {len(rep.candidates)} candidates: "
+        f"{dt * 1e3:.0f} ms host-only ({rep.n_pruned} pruned, "
+        f"{rep.n_derived} derived, verdict {rep.code})")
+    print(json.dumps({
+        "metric": "tune_gemm_ms",
+        "value": round_keep(dt * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "path": "analysis.tune.tune(gemm)",
+        "degradations": [],
+        "spec_source": "registry",
+        "n": n,
+        "candidates": len(rep.candidates),
+        "pruned": rep.n_pruned,
+        "derived": rep.n_derived,
+        "verdict": rep.code,
+        "device_dispatches": dispatched,
+    }), flush=True)
+
+
+def bench_serve_placement(n_requests: int = 48) -> None:
+    """Interference-aware placement A/B (round r16 on): client-side p99
+    under an ADVERSARIAL co-tenant mix — one tenant's backlog alternating
+    workloads whose pairwise composed interference differs, so the
+    placement chooser (PLUSS_SERVE_PLACEMENT=on) has real reordering
+    decisions — against the advisory-only control (off, the default) as
+    ``vs_baseline``.  Both arms run in one process with equally warm
+    caches; max_batch=1 keeps every dispatch a distinct placement
+    decision.  Ordering is the only degree of freedom, so any p99 delta
+    is the placement discipline itself.  The pair-cost memo is pre-warmed
+    alongside the plan caches (a long-lived daemon pays each pair's
+    derivation exactly once, bounded by the memo) so the A/B measures
+    steady-state placement, not the one-time fill."""
+    import tempfile
+    import threading
+
+    from pluss.serve import Client, ServeConfig, Server
+    from pluss.serve.protocol import parse_request
+
+    # adversarial mix: distinct dispatch keys from one tenant, queued
+    # deep enough that the chooser sees a multi-request backlog
+    pool = [
+        {"model": "gemm", "n": 32, "threads": 4, "chunk": 4},
+        {"model": "stencil3d", "n": 32, "threads": 4, "chunk": 4},
+        {"model": "atax", "n": 32, "threads": 4, "chunk": 4},
+        {"model": "syrk", "n": 32, "threads": 4, "chunk": 4},
+    ]
+    results: dict[str, tuple[float, float]] = {}
+    for label, knob in (("placement", "on"), ("advisory_only", "off")):
+        sock = tempfile.mktemp(prefix="pluss_bench_place_", suffix=".sock")
+        prev = os.environ.get("PLUSS_SERVE_PLACEMENT")
+        os.environ["PLUSS_SERVE_PLACEMENT"] = knob
+        try:
+            srv = Server(socket_path=sock,
+                         config=ServeConfig(max_batch=1, max_queue=256))
+        finally:
+            if prev is None:
+                os.environ.pop("PLUSS_SERVE_PLACEMENT", None)
+            else:
+                os.environ["PLUSS_SERVE_PLACEMENT"] = prev
+        srv.start()
+        lat: list[float] = []
+        lock = threading.Lock()
+        try:
+            with Client(sock) as c:   # warm plans + executables per key
+                for q in pool:
+                    c.request(q)
+            if srv.batcher.placer is not None:   # warm the pair-cost memo
+                parsed = [parse_request(dict(q)) for q in pool]
+                for a in parsed:
+                    srv.batcher.placer.note_dispatch(a)
+                    srv.batcher.placer.choose(tuple(parsed))
+                srv.batcher.placer.note_dispatch(parsed[0])
+
+            def worker(chunk):
+                with Client(sock) as c:
+                    for q in chunk:
+                        t0 = time.perf_counter()
+                        r = c.request(q)
+                        dt = (time.perf_counter() - t0) * 1e3
+                        if r.get("ok"):
+                            with lock:
+                                lat.append(dt)
+
+            reqs = [dict(pool[i % len(pool)]) for i in range(n_requests)]
+            chunks = [reqs[i::4] for i in range(4)]
+            threads = [threading.Thread(target=worker, args=(ch,))
+                       for ch in chunks if ch]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            srv.shutdown()
+        if not lat:
+            raise RuntimeError(
+                f"serve placement bench ({label}): no ok responses")
+        lat.sort()
+        results[label] = (lat[len(lat) // 2],
+                          lat[min(len(lat) - 1, int(0.99 * len(lat)))])
+        log(f"bench: serve placement={knob} p50 {results[label][0]:.1f} "
+            f"ms, p99 {results[label][1]:.1f} ms over {len(lat)} requests")
+    on, off = results["placement"], results["advisory_only"]
+    print(json.dumps({
+        "metric": "serve_placement_p99_ms",
+        "value": round_keep(on[1], 3),
+        "unit": "ms",
+        # >1 means placement-aware beat the advisory-only control
+        "vs_baseline": round_keep(off[1] / on[1] if on[1] else None, 3),
+        "path": "serve(PLUSS_SERVE_PLACEMENT=on)",
+        "degradations": [],
+        "advisory_only_p99_ms": round_keep(off[1], 3),
+        "placement_p50_ms": round_keep(on[0], 3),
+        "advisory_only_p50_ms": round_keep(off[0], 3),
+        "requests": n_requests,
+    }), flush=True)
+
+
 def main() -> int:
     os.chdir(os.path.dirname(os.path.abspath(__file__)))
     # persistent XLA compilation cache: the flagship compiles cost minutes
@@ -1167,6 +1305,16 @@ def main() -> int:
                 bench_cotenancy()
             except Exception as e:
                 log(f"bench: cotenancy metric failed: {e}")
+        if budget_ok("tune", 60):
+            try:
+                bench_tune()
+            except Exception as e:
+                log(f"bench: tune metric failed: {e}")
+        if budget_ok("serve_placement", 120):
+            try:
+                bench_serve_placement()
+            except Exception as e:
+                log(f"bench: serve placement metric failed: {e}")
         if budget_ok("warmstart", 180):
             try:
                 bench_warmstart(128, cpu=True)
@@ -1347,6 +1495,20 @@ def main() -> int:
             bench_cotenancy()
         except Exception as e:
             log(f"bench: cotenancy metric failed: {e}")
+
+    # schedule-tuning headlines (round r16 on): host-only proof-carrying
+    # search latency (zero-dispatch witnessed) + the placement-aware vs
+    # advisory-only serve p99 A/B under an adversarial co-tenant mix
+    if budget_ok("tune", 60):
+        try:
+            bench_tune()
+        except Exception as e:
+            log(f"bench: tune metric failed: {e}")
+    if budget_ok("serve_placement", 120):
+        try:
+            bench_serve_placement()
+        except Exception as e:
+            log(f"bench: serve placement metric failed: {e}")
 
     # accuracy half of the north star (BASELINE.json: "miss-ratio-curve L2
     # error vs C++ baseline" within 1%): MRC of the TPU pipeline vs the

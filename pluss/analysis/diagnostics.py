@@ -37,6 +37,12 @@ Code families mirror the analyzer's four passes:
   the declared cache size (PL801), proven-bounded benign co-tenancy
   (PL802), and the typed refusal when a workload pair lies outside the
   composition model's contract (PL803 — never a silent approximation).
+- ``PL9xx`` tuning (:mod:`pluss.analysis.tune`): the proof-carrying
+  schedule auto-optimizer — proven-best schedule with margin (PL901),
+  tie-within-epsilon set (PL902), typed refusal when a candidate falls
+  off the derivability ladder (PL903 — the PL701/702 cause chain
+  attaches), and the ``--check`` cross-validation alarm when a live
+  engine run disagrees with the predicted winner (PL904).
 
 Severity semantics: ERROR means the spec is wrong (out-of-bounds access,
 undeclared array, contract violation) — ``pluss lint`` exits nonzero.
@@ -135,6 +141,17 @@ CODES: dict[str, tuple[str, str]] = {
     "PL803": ("interference", "co-tenancy pair outside the composition "
                               "model's contract (typed refusal, never a "
                               "silent approximation)"),
+    "PL901": ("tuning", "proven-best schedule: every competitor scored "
+                        "worse beyond the tie epsilon or was dominance-"
+                        "pruned (margin attached)"),
+    "PL902": ("tuning", "schedule tie within epsilon: the canonical pick "
+                        "plus the full tie set"),
+    "PL903": ("tuning", "tune refused: a candidate schedule fell off the "
+                        "derivability ladder (PL701/702 cause chain "
+                        "attached, never a silent approximation)"),
+    "PL904": ("tuning", "tuned-winner cross-check alarm: live engine run "
+                        "disagrees with the predicted MRC beyond the "
+                        "epsilon"),
 }
 
 

@@ -117,12 +117,28 @@ def _footprint_doc(fp, bracket) -> dict:
     }
 
 
-def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
+def _cache_geometry_or_usage(args, p):
+    """The shared cache-geometry parse (analyze/cotenancy/tune): one
+    helper (:func:`pluss.model.hierarchy.cache_geometry`), so the three
+    surfaces agree about the LLC by construction.  Malformed flags are
+    usage errors, never tracebacks."""
+    from pluss.model import hierarchy as hier_mod
+
+    try:
+        return hier_mod.cache_geometry(args.cache_kb, args.cache_levels,
+                                       args.assoc)
+    except ValueError as e:
+        p.error(f"{args.mode} mode: {e}")
+
+
+def _lint_main(args, out, cfg: SamplerConfig | None = None,
+               hier=None) -> int:
     """``pluss lint|analyze <model|--all> [--json]`` — pure host analysis,
     exits 1 when any model has ERROR-level diagnostics.  ``analyze``
     (``cfg`` set) adds the schedule-aware passes: placement-refined race
     verdicts (PL304/PL305), line-granular false-sharing detection
-    (PL5xx), and the footprint/MRC-bound report."""
+    (PL5xx), and the footprint/MRC-bound report under the shared
+    ``hier`` cache geometry."""
     import json as json_mod
 
     from pluss import analysis
@@ -160,7 +176,7 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None) -> int:
                 from pluss.model import hierarchy as hier_mod
 
                 hierarchies[spec.name] = hier_mod.hierarchy_doc(
-                    rep.rihist, cfg)
+                    rep.rihist, cfg, hier)
         all_diags += analysis.with_model(diags, spec.name)
         errors += analysis.error_count(diags)
     mode = "lint" if cfg is None else "analyze"
@@ -327,9 +343,12 @@ def _cotenancy_main(args, p, out) -> int:
     if len(names) < 2:
         p.error("cotenancy mode: co-tenancy needs >= 2 workloads "
                 f"(got {args.target!r}; join them with '+')")
+    # the shared geometry parse: --cache-kb / --cache-levels retarget the
+    # verdict point AND the read-off LLC together (the r16 drift fix)
+    llc_kb, _hier = _cache_geometry_or_usage(args, p)
     cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk,
-                        **({} if args.cache_kb is None
-                           else {"cache_kb": args.cache_kb}))
+                        **({} if llc_kb is None
+                           else {"cache_kb": llc_kb}))
     inputs, refusals = interference.from_models(names, cfg, args.n)
     if len(inputs) < 2:
         rep = interference.CotenancyReport(
@@ -381,6 +400,97 @@ def _cotenancy_main(args, p, out) -> int:
                   f"{rep.cache_kb} KB, threshold {rep.threshold:g}: "
                   f"{n_sev} severe, {len(rep.verdicts) - n_sev} benign, "
                   f"{n_ref} refused\n")
+    return rc
+
+
+def _tune_main(args, p, out, setup_platform) -> int:
+    """``pluss tune <model|--all> [--json|--check|--sarif]`` — the
+    proof-carrying static schedule auto-optimizer (:mod:`pluss.analysis.
+    tune`): exhaustive-with-pruning search over (threads, chunk, window,
+    share_cap) — axes from --sweep-threads/--sweep-chunks/--window/
+    --share-cap — scored entirely on the host at the declared LLC
+    (--cache-kb / --cache-levels / --assoc, or the PLUSS_CACHE_* env).
+    Typed verdicts: PL901 proven-best, PL902 tie-within-epsilon, PL903
+    refusal (rc 1), PL904 engine cross-check alarm under ``--check``
+    (the only device work in this mode)."""
+    import json as json_mod
+
+    from pluss import analysis
+    from pluss.analysis import tune as tune_mod
+
+    if args.target is not None and args.all:
+        p.error("tune mode: give a model or --all, not both")
+    if args.target is not None:
+        if args.target not in REGISTRY:
+            p.error(f"tune mode: unknown model {args.target!r}")
+        args.model = args.target
+    llc_kb, hier = _cache_geometry_or_usage(args, p)
+    try:
+        ts = [int(t) for t in args.sweep_threads.split(",")]
+        cks = [int(c) for c in args.sweep_chunks.split(",")]
+    except ValueError:
+        p.error("tune mode: malformed --sweep-threads/--sweep-chunks "
+                "(want comma-separated ints)")
+    cands = tune_mod.space(ts, cks, (args.window,), (args.share_cap,))
+    if args.all:
+        targets = [(nm, REGISTRY[nm](args.n)) for nm in sorted(REGISTRY)]
+    else:
+        targets = [(args.model, REGISTRY[args.model](args.n))]
+    docs: dict[str, dict] = {}
+    reports = []
+    all_diags = []
+    rc = 0
+    for name, spec in targets:
+        rep = tune_mod.tune(spec, candidates=cands, hier=hier)
+        reports.append((name, spec, rep))
+        docs[spec.name] = rep.doc()
+        all_diags += analysis.with_model(rep.diagnostics, spec.name)
+        if rep.code == "PL903":
+            rc = 1
+    if args.check:
+        # cross-validate each winner against ONE live engine run under
+        # the tuned schedule (the only device work in tune mode)
+        setup_platform()
+        for name, spec, rep in reports:
+            if rep.winner is None:
+                print(f"pluss tune: {spec.name}: check skipped "
+                      "(refused)", file=sys.stderr)
+                continue
+            ok, detail, diags = tune_mod.check_winner(spec, rep)
+            docs[spec.name]["check"] = detail
+            all_diags += analysis.with_model(diags, spec.name)
+            if not ok:
+                rc = 1
+                print(f"pluss tune: {spec.name}: CHECK FAILED (PL904) "
+                      f"{detail}", file=sys.stderr)
+            else:
+                kind = "bit-identical" if detail["mrc_exact"] \
+                    else f"l2={detail['mrc_l2_error']:.2e}"
+                print(f"pluss tune: {spec.name}: winner "
+                      f"{rep.winner.candidate.label()} verified against "
+                      f"engine.run (histograms bit-identical, MRC "
+                      f"{kind})", file=sys.stderr)
+    if args.sarif:
+        from pluss.analysis import sarif as sarif_mod
+
+        sarif_mod.write_sarif(args.sarif, all_diags)
+        print(f"pluss tune: SARIF log at {args.sarif}", file=sys.stderr)
+    if args.json:
+        doc = {"target_kb": reports[0][2].target_kb,
+               "hierarchy": docs[reports[0][1].name]["hierarchy"],
+               "models": docs}
+        out.write(json_mod.dumps(doc, indent=1) + "\n")
+    else:
+        for name, spec, rep in reports:
+            v = rep.diagnostics[0]
+            out.write(f"{spec.name}: [{v.code}] {v.message}\n")
+        n_best = sum(1 for _, _, r in reports if r.code == "PL901")
+        n_tie = sum(1 for _, _, r in reports if r.code == "PL902")
+        n_ref = sum(1 for _, _, r in reports if r.code == "PL903")
+        out.write(f"pluss tune: {len(reports)} model(s) over "
+                  f"{len(cands)} candidate(s) at "
+                  f"{reports[0][2].target_kb} KB LLC: {n_best} "
+                  f"proven-best, {n_tie} tie(s), {n_ref} refused\n")
     return rc
 
 
@@ -576,15 +686,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("mode",
                    choices=("acc", "speed", "mrc", "trace", "sweep",
                             "sample", "lint", "analyze", "predict",
-                            "cotenancy", "stats", "serve", "import",
-                            "spec"))
+                            "cotenancy", "tune", "stats", "serve",
+                            "import", "spec"))
     p.add_argument("target", nargs="?", default=None,
                    help="stats mode: telemetry event stream (events.jsonl) "
                         "to aggregate; import mode: the .py (DSL) or .c "
                         "(pragma-C) source file; spec mode: dump | load; "
                         "predict mode: the model to predict; cotenancy "
                         "mode: the co-scheduled workloads as "
-                        "modelA+modelB[+...]")
+                        "modelA+modelB[+...]; tune mode: the model to "
+                        "auto-tune")
     p.add_argument("arg2", nargs="?", default=None,
                    help="spec mode: the model to dump / the spec JSON "
                         "file to load")
@@ -652,9 +763,21 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--threads", type=int, default=4, help="simulated threads")
     p.add_argument("--chunk", type=int, default=4, help="schedule chunk size")
     p.add_argument("--cache-kb", type=int, default=None, metavar="KB",
-                   help="cotenancy mode: shared-cache capacity in KB for "
-                        "the verdict point (default: the SamplerConfig "
-                        "cache_kb)")
+                   help="analyze/cotenancy/tune mode: largest-cache "
+                        "capacity in KB — the verdict/tuning point AND "
+                        "the hierarchy read-off LLC, parsed through one "
+                        "shared geometry helper so the modes can't drift "
+                        "(default: the SamplerConfig cache_kb)")
+    p.add_argument("--cache-levels", default=None, metavar="KB:KB:...",
+                   help="analyze/cotenancy/tune mode: declared cache "
+                        "hierarchy levels in KB, ascending (e.g. "
+                        "32:512:8192) — overrides PLUSS_CACHE_LEVELS; "
+                        "the last level is the verdict/tuning LLC.  "
+                        "Mutually exclusive with --cache-kb")
+    p.add_argument("--assoc", type=int, default=None, metavar="WAYS",
+                   help="analyze/cotenancy/tune mode: ways per set for "
+                        "the hierarchy model (0 = fully associative; "
+                        "overrides PLUSS_CACHE_ASSOC)")
     p.add_argument("--reps", type=int, default=3, help="speed-mode repetitions")
     p.add_argument("--share-cap", type=int, default=SHARE_CAP)
     p.add_argument("--window", type=int, default=None,
@@ -790,15 +913,17 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.target is not None and args.mode not in ("stats", "import",
                                                      "spec", "predict",
-                                                     "cotenancy"):
+                                                     "cotenancy", "tune"):
         # the optional positionals exist only for `stats <events.jsonl>`,
         # `import <file>`, `spec <dump|load> <what>`, `predict <model>`,
-        # and `cotenancy <a+b>`; anywhere else a stray argument must stay
-        # the usage error it always was (`pluss lint gemm` would otherwise
-        # silently lint the DEFAULT model and report it clean)
+        # `cotenancy <a+b>`, and `tune <model>`; anywhere else a stray
+        # argument must stay the usage error it always was (`pluss lint
+        # gemm` would otherwise silently lint the DEFAULT model and
+        # report it clean)
         p.error(f"unexpected argument {args.target!r} for mode "
                 f"{args.mode!r} (positional input is for stats/import/"
-                "spec/predict/cotenancy modes only; use --model/--file)")
+                "spec/predict/cotenancy/tune modes only; use "
+                "--model/--file)")
     if args.arg2 is not None and args.mode != "spec":
         p.error(f"unexpected argument {args.arg2!r} for mode "
                 f"{args.mode!r}")
@@ -822,11 +947,15 @@ def main(argv: list[str] | None = None) -> int:
         # pure host analysis: no accelerator probe, no platform setup —
         # a broken spec must be reportable from any box, instantly.
         # analyze adds the schedule-aware passes under the CLI's own
-        # (--threads, --chunk) schedule
-        cfg = SamplerConfig(thread_num=args.threads,
-                            chunk_size=args.chunk) \
-            if args.mode == "analyze" else None
-        return _lint_main(args, sys.stdout, cfg)
+        # (--threads, --chunk) schedule and the shared cache geometry
+        cfg = hier = None
+        if args.mode == "analyze":
+            llc_kb, hier = _cache_geometry_or_usage(args, p)
+            cfg = SamplerConfig(thread_num=args.threads,
+                                chunk_size=args.chunk,
+                                **({} if llc_kb is None
+                                   else {"cache_kb": llc_kb}))
+        return _lint_main(args, sys.stdout, cfg, hier)
 
     def setup_platform() -> None:
         from pluss import plancache
@@ -872,6 +1001,12 @@ def main(argv: list[str] | None = None) -> int:
         # whose oracle is a numpy schedule simulation, never boots a
         # device
         return _cotenancy_main(args, p, sys.stdout)
+
+    if args.mode == "tune":
+        # proof-carrying schedule auto-optimizer (pluss/analysis/
+        # tune.py): the search is host math with zero dispatches —
+        # --check alone boots a device for the winner's engine cross-run
+        return _tune_main(args, p, sys.stdout, setup_platform)
 
     setup_platform()
 
@@ -1018,6 +1153,12 @@ def main(argv: list[str] | None = None) -> int:
         hier_block = sweep_mod.hierarchy_block(spec, pts)
         if hier_block:
             out.write(hier_block + "\n")
+        # proof-carrying tune over the same swept axes: each sampled
+        # point's miss ratio at the tuning LLC vs the proven-best
+        # schedule's predicted score (pluss/analysis/tune.py)
+        tuned = sweep_mod.tuned_block(spec, pts)
+        if tuned:
+            out.write(tuned + "\n")
     else:  # trace: dynamic replay (BASELINE config 5; bypasses CRI like the
         # reference's pluss_access path — see pluss/trace.py)
         if not args.file:

@@ -74,6 +74,67 @@ class HierarchyConfig:
         )
 
 
+def cache_geometry(cache_kb: int | None = None,
+                   cache_levels: str | None = None,
+                   assoc: int | None = None,
+                   policy: str | None = None
+                   ) -> tuple[int | None, HierarchyConfig]:
+    """ONE parser for the CLI's cache-geometry surface — analyze,
+    cotenancy, and tune all build their geometry here, so the three
+    modes can never drift (the r16 fix: ``pluss cotenancy --cache-kb``
+    used to retarget only the verdict point while ``analyze``'s
+    ``hierarchy:`` block kept reading the env-declared levels).
+
+    Returns ``(llc_kb, HierarchyConfig)``: ``llc_kb`` is the resolved
+    largest-cache capacity in KB — the SamplerConfig ``cache_kb`` /
+    verdict-point override — or None when neither flag names one (the
+    defaults already agree: ``DEFAULT_LEVELS_KB[-1]`` is the
+    SamplerConfig default).  Precedence: the ``PLUSS_CACHE_*`` env knobs
+    are the base; ``cache_levels`` (``"32:512:8192"``, colon- or
+    comma-separated KB ascending) and ``assoc``/``policy`` override
+    them; a bare ``cache_kb`` retargets the LLC, dropping declared
+    levels at or above it, so the verdict point and the hierarchy
+    read-off always agree about the largest cache.  Declaring the LLC
+    twice (``cache_kb`` AND ``cache_levels``) or a malformed/non-
+    ascending level list raises ``ValueError`` — callers turn it into a
+    usage error, never a traceback."""
+    hier = HierarchyConfig.from_env()
+    if cache_kb is not None and cache_levels is not None:
+        raise ValueError("give --cache-kb or --cache-levels, not both "
+                         "(each declares the largest cache)")
+    llc_kb: int | None = None
+    if cache_levels is not None:
+        try:
+            levels = tuple(int(t) for t in
+                           cache_levels.replace(":", ",").split(",") if t)
+        except ValueError:
+            raise ValueError(
+                f"malformed --cache-levels {cache_levels!r} (want "
+                "colon- or comma-separated KB, e.g. 32:512:8192)")
+        if not levels or any(k <= 0 for k in levels) \
+                or list(levels) != sorted(set(levels)):
+            raise ValueError(
+                f"--cache-levels {cache_levels!r} must be positive and "
+                "strictly ascending")
+        hier = dataclasses.replace(hier, levels_kb=levels)
+        llc_kb = levels[-1]
+    elif cache_kb is not None:
+        if cache_kb <= 0:
+            raise ValueError(f"--cache-kb must be positive, got {cache_kb}")
+        kept = tuple(k for k in hier.levels_kb if k < cache_kb)
+        hier = dataclasses.replace(hier, levels_kb=kept + (cache_kb,))
+        llc_kb = int(cache_kb)
+    if assoc is not None:
+        if assoc < 0:
+            raise ValueError(f"--assoc must be >= 0, got {assoc}")
+        hier = dataclasses.replace(hier, assoc=int(assoc))
+    if policy is not None:
+        if policy not in ("lru", "random"):
+            raise ValueError(f"unknown cache policy {policy!r}")
+        hier = dataclasses.replace(hier, policy=policy)
+    return llc_kb, hier
+
+
 def entries_of_kb(kb: int) -> int:
     """Cache entries (lines the AET axis counts) of a KB capacity — the
     same ``kb * 1024 / sizeof(double)`` scale as

@@ -523,6 +523,36 @@ def interference_breakdown(counters: dict[str, float],
     return lines
 
 
+def placement_breakdown(counters: dict[str, float],
+                        gauges: dict[str, float]) -> list[str]:
+    """The interference-aware placement block (r16): how often the
+    batcher's lead pick consulted the static pairwise-interference cost
+    (``PLUSS_SERVE_PLACEMENT=on``), how many picks actually reordered
+    within a tenant's backlog, memo efficiency, and the last chosen
+    pair's predicted cost.  Empty on the advisory-only A/B control."""
+    ch = counters.get("serve.placement.choices")
+    errs = counters.get("serve.placement.errors")
+    if not ch and not errs:
+        return []
+    lines = ["interference-aware placement:"]
+    re_ = counters.get("serve.placement.reorders", 0.0)
+    lines.append(f"  {'choices (of them reorders)':<28} "
+                 f"{int(ch or 0):>9}  ({int(re_)} reordered)")
+    mh = counters.get("serve.placement.memo_hits")
+    if mh:
+        lines.append(f"  {'pair-cost memo hits':<28} {int(mh):>9}")
+    hr = counters.get("serve.placement.head_rescues")
+    if hr:
+        lines.append(f"  {'starvation-guard rescues':<28} {int(hr):>9}")
+    cost = gauges.get("serve.placement.last_cost")
+    if cost is not None:
+        lines.append(f"  {'last pair cost':<28} {_fmt_val(cost):>9}")
+    if errs:
+        lines.append(f"  {'placement errors (FIFO kept)':<28} "
+                     f"{int(errs):>9}")
+    return lines
+
+
 def render(records: list[dict], out) -> None:
     """Write the human report for one loaded stream."""
     n_spans = sum(1 for r in records if r.get("ev") == "span")
@@ -578,6 +608,9 @@ def render(records: list[dict], out) -> None:
     iblock = interference_breakdown(counters, gauges)
     if iblock:
         out.write("\n".join(iblock) + "\n")
+    pblock = placement_breakdown(counters, gauges)
+    if pblock:
+        out.write("\n".join(pblock) + "\n")
 
 
 def main(path: str, out, err, check: bool = False) -> int:

@@ -178,16 +178,22 @@ class AdmissionQueue:
     # ------------------------------------------------------------------
     # pop side: deficit round-robin across tenants
 
-    def pop(self, timeout: float | None = None
+    def pop(self, timeout: float | None = None, chooser=None
             ) -> tuple[Request | None, list[Request]]:
         """``(head, expired)``: the next still-live request in DRR order
         (None on timeout / empty-and-closed), plus any requests that
         expired while queued — the caller owes each of those a
-        ``DeadlineExceeded`` response."""
+        ``DeadlineExceeded`` response.
+
+        ``chooser`` (r16 placement hook), when given, is called with the
+        served tenant's live deque as a tuple and returns the index to
+        dispatch — fairness is untouched (DRR still picks WHICH tenant;
+        the hook only reorders within that tenant's own backlog), and a
+        misbehaving chooser degrades to FIFO."""
         expired: list[Request] = []
         with self._cv:
             while True:
-                req = self._pop_drr(expired)
+                req = self._pop_drr(expired, chooser)
                 if req is not None:
                     self._gauge()
                     return req, expired
@@ -204,7 +210,8 @@ class AdmissionQueue:
                         self._gauge()
                     return None, expired
 
-    def _pop_drr(self, expired: list[Request]) -> Request | None:
+    def _pop_drr(self, expired: list[Request],
+                 chooser=None) -> Request | None:
         """One DRR scan (lock held): serve the first tenant whose deficit
         covers a request, drain expired heads, retire emptied tenants."""
         for _ in range(len(self._ring)):
@@ -223,7 +230,19 @@ class AdmissionQueue:
             self._deficit[t] = self._deficit.get(t, 0.0) + _QUANTUM
             if self._deficit[t] >= _COST:
                 self._deficit[t] -= _COST
-                req = dq.popleft()
+                idx = 0
+                if chooser is not None and len(dq) > 1:
+                    try:
+                        idx = int(chooser(tuple(dq)))
+                    except Exception:  # noqa: BLE001 — chooser is advisory
+                        idx = 0
+                    # head is proven live by the drain loop above; a
+                    # chosen mid-queue request may have expired — leave
+                    # it for the lazy drain and serve the head instead
+                    if not 0 <= idx < len(dq) or dq[idx].expired():
+                        idx = 0
+                req = dq[idx]
+                del dq[idx]
                 self._count -= 1
                 self._ring.rotate(-1)     # the NEXT tenant leads next pop
                 return req

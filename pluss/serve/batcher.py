@@ -39,7 +39,7 @@ class Batcher:
     """Forms batches of compatible requests from the admission queue."""
 
     def __init__(self, queue: AdmissionQueue, max_batch: int = 16,
-                 max_delay_ms: float = 10.0):
+                 max_delay_ms: float = 10.0, placer=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_delay_ms < 0:
@@ -49,6 +49,11 @@ class Batcher:
         self.max_batch = max_batch
         self.max_delay_s = max_delay_ms / 1e3
         self.batching = max_batch > 1
+        #: optional r16 interference-aware placement
+        #: (:class:`pluss.serve.placement.Placer`): its ``choose`` steers
+        #: the pop's within-tenant pick and ``note_dispatch`` records
+        #: each lead so the NEXT pick composes against it
+        self.placer = placer
 
     def next_batch(self, timeout: float | None = 0.25
                    ) -> tuple[list[Request], list[Request]]:
@@ -56,9 +61,12 @@ class Batcher:
         singleton; empty on pop timeout or drained-and-closed queue) plus
         any requests found expired on the way — the server answers those
         with ``DeadlineExceeded``."""
-        lead, expired = self.queue.pop(timeout)
+        chooser = self.placer.choose if self.placer is not None else None
+        lead, expired = self.queue.pop(timeout, chooser=chooser)
         if lead is None:
             return [], expired
+        if self.placer is not None:
+            self.placer.note_dispatch(lead)
         batch = [lead]
         if not self.batching or lead.kind == "sleep":
             self._account(batch)

@@ -249,8 +249,16 @@ class Server:
             if journal_dir else None
         self._recovered: dict[str, dict] = {}   # rid -> parked response
         self._recovered_lock = threading.Lock()
+        # interference-aware placement (r16): when PLUSS_SERVE_PLACEMENT
+        # is on, the batcher's lead pick minimizes the predicted pairwise
+        # interference against the previous dispatch — ordering-only, so
+        # results stay bit-identical to the advisory-only A/B control
+        from pluss.serve.placement import Placer, placement_enabled
+
+        self._placer = Placer() if placement_enabled() else None
         self.batcher = Batcher(self.queue, self.config.max_batch,
-                               self.config.max_delay_ms)
+                               self.config.max_delay_ms,
+                               placer=self._placer)
         self.latency = obs.LatencyReservoir()
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
@@ -313,7 +321,8 @@ class Server:
                   addr=self.socket_path or f"{self.host}:{self.port}",
                   max_queue=self.config.max_queue,
                   max_batch=self.config.max_batch,
-                  max_delay_ms=self.config.max_delay_ms)
+                  max_delay_ms=self.config.max_delay_ms,
+                  placement=self._placer is not None)
         for name, target in (("pluss-serve-accept", self._accept_loop),
                              ("pluss-serve-slo", self._slo_loop)):
             t = threading.Thread(target=target, name=name, daemon=True)

@@ -411,6 +411,43 @@ def hierarchy_block(spec: LoopNestSpec,
     return "hierarchy:\n" + "\n".join(lines)
 
 
+def tuned_block(spec: LoopNestSpec,
+                points: Iterable[SweepPoint]) -> str:
+    """Tuned-vs-actual block for the sweep report (r16): one
+    :func:`pluss.analysis.tune.tune` pass over exactly the swept
+    (threads, chunk) axes, then per sampled point its own sampled miss
+    ratio at the tuning LLC next to the proof-carrying winner's
+    predicted score — so the sweep table shows, per schedule, how far it
+    sits from the statically proven best.  A tune refusal (PL903) prints
+    the typed verdict instead of numbers."""
+    from pluss.analysis import tune as tune_mod
+
+    points = list(points)
+    if not points:
+        return ""
+    threads = tuple(sorted({p.cfg.thread_num for p in points}))
+    chunks = tuple(sorted({p.cfg.chunk_size for p in points}))
+    rep = tune_mod.tune(spec, base_cfg=points[0].cfg,
+                        candidates=tune_mod.space(threads, chunks))
+    v = rep.diagnostics[0]
+    head = (f"tuned schedule (PL9xx, {rep.target_kb} KB LLC):\n"
+            f"  [{v.code}] {v.message}")
+    if rep.winner is None:
+        return head
+    w = rep.winner
+    lines = [head]
+    for p in points:
+        sampled = p.miss_ratio_at(rep.target_entries)
+        mark = " <- tuned winner" if (
+            p.cfg.thread_num == w.candidate.threads
+            and p.cfg.chunk_size == w.candidate.chunk) else ""
+        lines.append(
+            f"  threads={p.cfg.thread_num} chunk={p.cfg.chunk_size}: "
+            f"sampled {sampled:.4g} vs tuned best {w.score:.4g} "
+            f"(delta {sampled - w.score:+.4g}){mark}")
+    return "\n".join(lines)
+
+
 def carried_levels(spec: LoopNestSpec) -> str:
     """The static analyzer's PL303 carried-level classifications as a
     compact report block (ROADMAP PR-1 follow-up): one line per annotated

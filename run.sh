@@ -53,6 +53,23 @@ assert not errs, errs; print('cotenancy SARIF smoke: valid,', \
     len(doc['runs'][0]['results']), 'result(s)')" "$PLUSS_COT_SARIF" 1>&2
 rm -f "$PLUSS_COT_SARIF"
 
+# schedule-tuning gate (tier-1, r16): the proof-carrying auto-optimizer
+# (pluss/analysis/tune.py).  First the gemm search with --check — the
+# PL901/PL902 winner's predicted MRC must match a live engine run under
+# the tuned schedule bit-identically — and the PL9xx SARIF export
+# smoke-parsed through the structural validator; then the whole registry
+# (--all) searched and cross-checked the same way: any PL904 disagreement
+# or PL903 refusal on the 29 families fails the driver here.
+PLUSS_TUNE_SARIF=$(mktemp /tmp/pluss_tune_XXXX.sarif)
+JAX_PLATFORMS=cpu python -m pluss.cli tune gemm --n 16 --check --cpu \
+  --sarif "$PLUSS_TUNE_SARIF" 1>&2
+python -c "import json, sys; from pluss.analysis import sarif; \
+doc = json.load(open(sys.argv[1])); errs = sarif.validate(doc); \
+assert not errs, errs; print('tune SARIF smoke: valid,', \
+    len(doc['runs'][0]['results']), 'result(s)')" "$PLUSS_TUNE_SARIF" 1>&2
+rm -f "$PLUSS_TUNE_SARIF"
+JAX_PLATFORMS=cpu python -m pluss.cli tune --all --n 16 --check --cpu 1>&2
+
 # frontend import smoke (tier-1): the checked-in gemm.ppcg_omp-shaped C
 # source → tokenizer → recursive-descent parse → lower → share-span
 # derivation → PR-1 analyzer gate → engine run, with --check-model

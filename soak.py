@@ -706,6 +706,90 @@ def serve(n_requests: int, sd: int, chaos: bool,
                 if dm is not None and dm.poll() is None:
                     dm.kill()
                     dm.wait()
+
+        # ---- placement phase (r16): an ADVERSARIAL co-tenant mix —
+        # one tenant's backlog alternating distinct dispatch keys, built
+        # up behind a held device loop so the placement chooser faces
+        # real decisions — driven through a placement-aware daemon
+        # (PLUSS_SERVE_PLACEMENT=on) and the advisory-only control.
+        # Placement is ordering-only, so every response in BOTH arms
+        # must be bit-identical to the solo baselines; the on-arm must
+        # additionally witness actual choices in its counters.
+        adv_pool = [dict(pool[i], output="both") for i in range(3)]
+        for arm in ("on", "off"):
+            sockp = os.path.join(tmp, f"serve_place_{arm}.sock")
+            telp = os.path.join(tmp, f"serve_place_{arm}.jsonl")
+            errp = os.path.join(tmp, f"daemon_place_{arm}.err")
+            envp = dict(env2)
+            envp["PLUSS_SERVE_PLACEMENT"] = arm
+            daemonp = subprocess.Popen(
+                [sys.executable, "-m", "pluss.cli", "serve", "--socket",
+                 sockp, "--cpu", "--max-batch", "1", "--max-queue", "32",
+                 "--telemetry", telp],
+                cwd=here, env=envp, stderr=open(errp, "w"))
+            try:
+                for _ in range(240):
+                    if os.path.exists(sockp) or daemonp.poll() is not None:
+                        break
+                    time.sleep(0.5)
+                if daemonp.poll() is not None or not os.path.exists(sockp):
+                    print(f"serve soak: FAIL — placement={arm} daemon "
+                          "died at start; stderr tail:")
+                    print(open(errp).read()[-2000:])
+                    failures += 1
+                    continue
+                holderp = Client(sockp)
+                holderp.send({"sleep_ms": 1500})
+                time.sleep(0.2)   # let the hold reach the device loop
+                advs = [dict(adv_pool[i % len(adv_pool)],
+                             id=f"pl{arm}-{i}") for i in range(9)]
+                with Client(sockp) as c:
+                    ids = [c.send(q) for q in advs]
+                    got = {i: c.recv(i) for i in ids}
+                    stp = c.request({"op": "stats"})
+                    c.request({"op": "shutdown"})
+                holderp.close()
+                rcp = daemonp.wait(timeout=60)
+                if rcp != 0:
+                    print(f"serve soak: FAIL — placement={arm} daemon "
+                          f"exited {rcp}; stderr tail:")
+                    print(open(errp).read()[-2000:])
+                    failures += 1
+                arm_mis = 0
+                for q in advs:
+                    r = got.get(q["id"])
+                    if r is None or not r.get("ok"):
+                        print(f"serve soak: FAIL — placement={arm} "
+                              f"{q['id']} got {r}")
+                        failures += 1
+                        continue
+                    k = key_of(q)
+                    if k not in solo:
+                        solo[k] = solo_payload(q)
+                    if r["mrc"] != solo[k]["mrc"] \
+                            or r["histogram"] != solo[k]["histogram"]:
+                        arm_mis += 1
+                        print(f"serve soak: FAIL — placement={arm} "
+                              f"{q['id']} diverged from the solo run")
+                if arm_mis:
+                    failures += 1
+                n_choices = stp.get("counters", {}).get(
+                    "serve.placement.choices", 0)
+                if arm == "on" and not n_choices:
+                    print("serve soak: FAIL — placement-aware daemon "
+                          "recorded no placement choices under backlog")
+                    failures += 1
+                if arm == "off" and n_choices:
+                    print("serve soak: FAIL — advisory-only control "
+                          f"recorded {n_choices} placement choice(s)")
+                    failures += 1
+                print(f"serve soak: placement={arm} -> {len(advs)} "
+                      f"adversarial-mix responses bit-identical to solo, "
+                      f"{int(n_choices)} placement choice(s)", flush=True)
+            finally:
+                if daemonp.poll() is None:
+                    daemonp.kill()
+                    daemonp.wait()
     finally:
         if daemon.poll() is None:
             daemon.kill()

@@ -1,0 +1,529 @@
+"""r16: the proof-carrying schedule auto-optimizer (`pluss tune`, PL9xx)
+and interference-aware serve placement (PLUSS_SERVE_PLACEMENT).
+
+The load-bearing claims pinned here:
+
+- dominance pruning is SOUND: every pruned candidate, re-derived
+  exhaustively, scores strictly worse than the winner (five families);
+- the PL901/PL902 winner's prediction is bit-identical to a live
+  `engine.run` under the tuned schedule (`check_winner`, zero PL904);
+- refusals are TYPED: an underivable candidate yields PL903 with the
+  PL701/702 cause chain, exit code 1, never a silent approximation;
+- the window/share_cap axes provably never change the static score
+  (fiber memoization: widening them only grows the PL902 tie set);
+- placement is ordering-ONLY: the placement-aware queue/batcher/daemon
+  serves exactly the submitted requests with bit-identical payloads,
+  DRR fairness untouched, starvation structurally bounded;
+- the README documents the PL9xx rows, knobs, and search-space defaults
+  this code actually ships (drift fails here, not in a user's terminal).
+"""
+
+import json
+import time
+
+import pytest
+
+import tests.conftest  # noqa: F401  (CPU platform + x64)
+from pluss import cli, engine
+from pluss.analysis import ri as ri_mod
+from pluss.analysis import sarif
+from pluss.analysis import tune as tune_mod
+from pluss.analysis.diagnostics import CODES, Severity
+from pluss.config import DEFAULT, SHARE_CAP, SamplerConfig
+from pluss.model import hierarchy as hier_mod
+from pluss.models import REGISTRY
+from pluss.serve import Client, ServeConfig, Server
+from pluss.serve.admission import AdmissionQueue
+from pluss.serve.batcher import Batcher
+from pluss.serve.placement import _MAX_HEAD_SKIPS, Placer, pair_cost
+from pluss.serve.protocol import parse_request
+
+BASE = SamplerConfig(thread_num=4, chunk_size=4)
+
+
+# ---------------------------------------------------------------------------
+# search soundness
+
+
+@pytest.mark.parametrize("name", ["gemm", "syrk", "mvt", "atax",
+                                  "stencil3d"])
+def test_dominance_pruning_sound(name):
+    """Every candidate the search discards without derivation, derived
+    exhaustively after the fact, scores strictly worse than the winner
+    by more than the tie epsilon — a pruned candidate could NEVER have
+    won or entered the tie set."""
+    spec = REGISTRY[name](16)
+    rep = tune_mod.tune(spec, BASE)
+    assert rep.code in ("PL901", "PL902")
+    pruned = [s for s in rep.candidates if s.pruned]
+    assert pruned, f"{name}: nothing pruned — the soundness claim is vacuous"
+    for s in pruned:
+        assert s.score is None, "pruned candidates must not be derived"
+        cfg = s.candidate.cfg(BASE, rep.target_kb)
+        full = ri_mod.predict(spec, cfg)
+        true_score = tune_mod._score_of(full, cfg, rep.hier)
+        assert true_score is not None
+        assert true_score > rep.winner.score + tune_mod.TIE_EPS, (
+            f"{name}: pruned {s.candidate.label()} would have scored "
+            f"{true_score} vs winner {rep.winner.score}")
+        # the prune premise itself: the floor is a true lower bound
+        assert s.floor <= true_score + 1e-12
+
+
+def test_floor_is_lower_bound_for_derived_candidates():
+    """The compulsory floor used by the dominance proof bounds the real
+    LLC score from below on every candidate the search DID derive."""
+    rep = tune_mod.tune(REGISTRY["gemm"](16), BASE)
+    derived = [s for s in rep.candidates if s.score is not None]
+    assert derived
+    for s in derived:
+        assert s.floor <= s.score + 1e-12
+
+
+def test_pl901_winner_bit_identical_to_engine():
+    """A pinned-threads space yields a proven-best verdict whose
+    prediction survives the live engine cross-run bit-identically —
+    zero PL904."""
+    spec = REGISTRY["gemm"](16)
+    rep = tune_mod.tune(spec, BASE,
+                        candidates=tune_mod.space((8,), (1, 2, 4, 8)))
+    assert rep.code == "PL901"
+    assert rep.margin is not None and rep.margin > 0
+    assert rep.n_pruned > 0
+    ok, detail, diags = tune_mod.check_winner(spec, rep, BASE)
+    assert ok, detail
+    assert detail["histogram_identical"]
+    assert not diags, "no PL904 on agreement"
+
+
+def test_pl902_tie_canonical_pick_checks_clean():
+    """The honest-tie verdict: the canonical pick is the lowest
+    coordinate of the tie set, and it too survives the engine check."""
+    spec = REGISTRY["gemm"](16)
+    rep = tune_mod.tune(spec, BASE)
+    assert rep.code == "PL902"
+    assert len(rep.ties) > 1 and rep.winner in rep.ties
+    lowest = min(rep.ties, key=lambda s: (
+        s.candidate.threads, s.candidate.chunk,
+        s.candidate.window or 0, s.candidate.share_cap))
+    assert rep.winner is lowest
+    ok, detail, diags = tune_mod.check_winner(spec, rep, BASE)
+    assert ok and not diags, detail
+
+
+def test_pl903_typed_refusal_with_cause_chain():
+    """budget=1 forces every fiber off the derivability ladder: the
+    verdict is a WARNING-severity PL903 with the PL702 cause chain
+    attached, no winner, and check_winner refuses to run."""
+    rep = tune_mod.tune(REGISTRY["gemm"](16), BASE, budget=1)
+    assert rep.code == "PL903" and rep.winner is None
+    codes = {d.code for d in rep.diagnostics}
+    assert "PL903" in codes
+    assert codes & {"PL701", "PL702"}, "cause chain must attach"
+    pl903 = next(d for d in rep.diagnostics if d.code == "PL903")
+    assert pl903.severity is Severity.WARNING
+    with pytest.raises(ValueError):
+        tune_mod.check_winner(REGISTRY["gemm"](16), rep, BASE)
+
+
+def test_window_share_cap_axes_never_change_the_score():
+    """window/share_cap shape the dispatch, never the static reuse
+    distribution: widening those axes multiplies the tie set without
+    producing a new score value."""
+    spec = REGISTRY["gemm"](16)
+    rep = tune_mod.tune(spec, BASE, candidates=tune_mod.space(
+        (2,), (2,), windows=(None, 64), share_caps=(SHARE_CAP, 8)))
+    assert rep.code == "PL902"
+    scores = {s.score for s in rep.candidates}
+    assert len(scores) == 1, "one fiber, one score"
+    assert len(rep.ties) == 4
+    # canonical pick: window None (sorts as 0), smallest share_cap
+    assert rep.winner.candidate.window is None
+    assert rep.winner.candidate.share_cap == 8
+
+
+def test_tune_search_makes_zero_device_dispatches():
+    before = engine.DEVICE_DISPATCHES
+    tune_mod.tune(REGISTRY["syrk"](16), BASE)
+    assert engine.DEVICE_DISPATCHES == before
+
+
+def test_tune_empty_space_raises():
+    with pytest.raises(ValueError):
+        tune_mod.tune(REGISTRY["gemm"](16), BASE, candidates=[])
+
+
+# ---------------------------------------------------------------------------
+# shared cache-geometry helper (analyze / cotenancy / tune)
+
+
+def test_cache_geometry_bare_kb_reanchors_hierarchy():
+    llc, hier = hier_mod.cache_geometry(cache_kb=64)
+    assert llc == 64
+    assert hier.levels_kb[-1] == 64
+    # declared levels below the new LLC survive, larger ones drop
+    assert all(k < 64 for k in hier.levels_kb[:-1])
+
+
+def test_cache_geometry_levels_parse_both_separators():
+    for txt in ("32:512:8192", "32,512,8192"):
+        llc, hier = hier_mod.cache_geometry(cache_levels=txt)
+        assert llc == 8192
+        assert hier.levels_kb == (32, 512, 8192)
+
+
+def test_cache_geometry_rejects_conflicts_and_garbage():
+    with pytest.raises(ValueError):
+        hier_mod.cache_geometry(cache_kb=64, cache_levels="32:64")
+    with pytest.raises(ValueError):
+        hier_mod.cache_geometry(cache_levels="512:32")   # not ascending
+    with pytest.raises(ValueError):
+        hier_mod.cache_geometry(cache_levels="0:32")
+    with pytest.raises(ValueError):
+        hier_mod.cache_geometry(cache_levels="abc")
+    with pytest.raises(ValueError):
+        hier_mod.cache_geometry(assoc=-1)
+
+
+def test_cache_geometry_defaults_to_env_hierarchy():
+    llc, hier = hier_mod.cache_geometry()
+    assert llc is None
+    assert hier.levels_kb == hier_mod.HierarchyConfig.from_env().levels_kb
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+
+
+def test_cli_tune_text_verdict(capsys):
+    rc = cli.main(["tune", "gemm", "--n", "16"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "gemm16: [PL902]" in out
+    assert "pluss tune: 1 model(s)" in out
+
+
+def test_cli_tune_json_doc(capsys):
+    rc = cli.main(["tune", "gemm", "--n", "16", "--json",
+                   "--cache-levels", "32:512:8192"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["target_kb"] == 8192
+    m = doc["models"]["gemm16"]
+    assert m["verdict"] in ("PL901", "PL902")
+    assert m["n_pruned"] + m["n_derived"] <= len(m["candidates"])
+    assert all("floor" in c and "bracket" in c for c in m["candidates"])
+
+
+def test_cli_tune_check_and_sarif(tmp_path, capsys):
+    out_sarif = tmp_path / "tune.sarif"
+    rc = cli.main(["tune", "gemm", "--n", "16", "--check", "--cpu",
+                   "--sarif", str(out_sarif)])
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "verified against engine.run" in cap.err
+    assert "bit-identical" in cap.err
+    assert "CHECK FAILED" not in cap.err
+    doc = json.loads(out_sarif.read_text())
+    assert sarif.validate(doc) == []
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert rules <= set(CODES)
+    results = {r["ruleId"] for r in doc["runs"][0]["results"]}
+    assert results & {"PL901", "PL902"}
+
+
+def test_cli_tune_pl903_exits_nonzero(capsys, monkeypatch):
+    monkeypatch.setenv("PLUSS_PREDICT_BUDGET", "1")
+    rc = cli.main(["tune", "gemm", "--n", "16"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "[PL903]" in out
+
+
+def test_cli_tune_rejects_bad_usage(capsys):
+    with pytest.raises(SystemExit):
+        cli.main(["tune", "gemm", "--all", "--n", "16"])  # both targets
+    with pytest.raises(SystemExit):
+        cli.main(["tune", "gemm", "--n", "16",
+                  "--cache-kb", "64", "--cache-levels", "32:64"])
+    with pytest.raises(SystemExit):
+        cli.main(["tune", "gemm", "--n", "16", "--sweep-threads", "a,b"])
+
+
+def test_cli_cotenancy_and_analyze_share_geometry(capsys):
+    """Satellite 3: --cache-kb / --cache-levels thread through ONE
+    helper — cotenancy prices its verdict at the same LLC the analyze
+    hierarchy block declares."""
+    rc = cli.main(["cotenancy", "gemm+syrk", "--n", "16",
+                   "--cache-levels", "8:64"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "at 64 KB" in out
+    rc = cli.main(["analyze", "--model", "gemm", "--threads", "2",
+                   "--chunk", "2", "--cache-kb", "64"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "64KB" in out.replace(" ", "")
+
+
+@pytest.mark.slow
+def test_full_registry_tune_all_check(capsys):
+    """The r16 acceptance criterion: every family's winner verified
+    against a live engine run, no PL903, no PL904."""
+    rc = cli.main(["tune", "--all", "--n", "16", "--check", "--cpu"])
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert cap.err.count("verified against engine.run") == len(REGISTRY)
+    assert "CHECK FAILED" not in cap.err
+    assert "0 refused" in cap.out
+
+
+# ---------------------------------------------------------------------------
+# sweep integration
+
+
+def test_sweep_tuned_block():
+    from pluss import sweep as sweep_mod
+
+    spec = REGISTRY["gemm"](16)
+    pts = []
+    for t in (1, 2):
+        cfg = SamplerConfig(thread_num=t, chunk_size=2)
+        rep = ri_mod.predict(spec, cfg)
+        pts.append(sweep_mod.SweepPoint(cfg, rep.curve,
+                                        int(rep.prediction.accesses)))
+    block = sweep_mod.tuned_block(spec, pts)
+    assert block.startswith("tuned schedule (PL9xx")
+    assert "[PL90" in block
+    assert "<- tuned winner" in block
+    assert "vs tuned best" in block
+    assert sweep_mod.tuned_block(spec, []) == ""
+
+
+# ---------------------------------------------------------------------------
+# placement: chooser hook, placer, starvation guard
+
+
+def req(model="gemm", n=16, i=None, **kw):
+    d = {"model": model, "n": n, "threads": 2, "chunk": 2}
+    if i is not None:
+        d["id"] = f"q{i}"
+    d.update(kw)
+    return parse_request(d)
+
+
+def test_queue_chooser_selects_index():
+    q = AdmissionQueue(max_queue=16)
+    for i in range(3):
+        q.submit(req(i=i))
+    got, _ = q.pop(timeout=0, chooser=lambda cands: 1)
+    assert got.id == "q1"
+    # remaining order preserved around the extraction
+    assert q.pop(timeout=0)[0].id == "q0"
+    assert q.pop(timeout=0)[0].id == "q2"
+
+
+def test_queue_chooser_misbehavior_degrades_to_fifo():
+    for bad in (lambda c: 99, lambda c: -2,
+                lambda c: (_ for _ in ()).throw(RuntimeError("boom"))):
+        q = AdmissionQueue(max_queue=16)
+        for i in range(2):
+            q.submit(req(i=i))
+        got, _ = q.pop(timeout=0, chooser=bad)
+        assert got.id == "q0"
+
+
+def test_queue_chooser_never_serves_expired_midqueue():
+    q = AdmissionQueue(max_queue=16)
+    q.submit(req(i=0))
+    dead = req(i=1)
+    dead.deadline = time.monotonic() - 1.0
+    q.submit(req(i=2))
+    # sneak the expired request mid-deque (past submit's own hygiene)
+    q._q[""].insert(1, dead)
+    q._count += 1
+    got, _ = q.pop(timeout=0, chooser=lambda cands: 1)
+    assert got.id == "q0", "an expired mid-queue pick must fall back"
+
+
+def test_queue_chooser_scoped_to_drr_tenant():
+    """Fairness untouched: the chooser only ever sees ONE tenant's
+    backlog — DRR still decides which tenant is served."""
+    q = AdmissionQueue(max_queue=16)
+    q.submit(req(i=0, tenant="a"))
+    q.submit(req(i=1, tenant="a"))
+    q.submit(req(i=2, tenant="b"))
+    seen = []
+
+    def spy(cands):
+        seen.append(tuple(r.tenant for r in cands))
+        return 0
+
+    while q.pop(timeout=0, chooser=spy)[0] is not None:
+        pass
+    assert all(len(set(ts)) == 1 for ts in seen)
+
+
+def test_pair_cost_same_and_refused():
+    a = req("gemm")
+    c = pair_cost(a.spec, a.cfg, req("syrk", n=12).spec,
+                  req("syrk", n=12).cfg)
+    assert c >= 0.0
+
+
+def test_placer_prefers_previous_key_and_memoizes():
+    p = Placer()
+    prev = req("gemm")
+    p.note_dispatch(prev)
+    cands = (req("stencil3d"), req("gemm"), req("atax"))
+    # same dispatch key as the previous lead costs 0.0 -> wins
+    assert p.choose(cands) == 1
+    assert len(p._memo) == 2   # gemm x {stencil3d, atax}
+    memo_before = dict(p._memo)
+    assert p.choose(cands) == 1
+    assert p._memo == memo_before, "second round rides the memo"
+
+
+def test_placer_trivial_cases_are_fifo():
+    p = Placer()
+    assert p.choose((req(i=0), req(i=1))) == 0   # no previous dispatch
+    p.note_dispatch(req("gemm"))
+    assert p.choose((req("syrk", n=12),)) == 0   # singleton
+    sleep = parse_request({"sleep_ms": 5})
+    p.note_dispatch(sleep)                       # non-spec lead clears
+    assert p.choose((req(i=0), req("syrk", n=12, i=1))) == 0
+
+
+def test_placer_starvation_guard_rescues_head():
+    p = Placer()
+    prev = req("syrk", n=12)
+    p.note_dispatch(prev)
+    head, cheap = req("gemm", i=0), req("syrk", n=12, i=1)
+    # pin the costs so no derivation runs: head pairs costly, cheap
+    # coalesces with the previous key (cost 0 by identity)
+    p._memo[frozenset((prev.batch_key(), head.batch_key()))] = 1.0
+    picks = [p.choose((head, cheap)) for _ in range(_MAX_HEAD_SKIPS + 1)]
+    assert picks[:_MAX_HEAD_SKIPS] == [1] * _MAX_HEAD_SKIPS
+    assert picks[_MAX_HEAD_SKIPS] == 0, (
+        "after the skip bound the head must be served unconditionally")
+
+
+def test_batcher_with_placer_serves_exactly_the_submitted_set():
+    """Ordering-only, structurally: the placement-aware batcher drains
+    the same request OBJECTS the queue admitted — nothing dropped,
+    nothing duplicated, nothing mutated — in a possibly different
+    order."""
+    models = ["gemm", "stencil3d", "gemm", "atax", "syrk", "gemm"]
+    q = AdmissionQueue(max_queue=32)
+    placer = Placer()
+    placer.note_dispatch(req("gemm"))
+    b = Batcher(q, max_batch=1, placer=placer)
+    submitted = [req(m, n=16 if m != "syrk" else 12, i=i)
+                 for i, m in enumerate(models)]
+    for r in submitted:
+        q.submit(r)
+    drained = []
+    while True:
+        batch, expired = b.next_batch(timeout=0)
+        assert not expired
+        if not batch:
+            break
+        drained += batch
+    assert sorted(r.id for r in drained) == \
+        sorted(r.id for r in submitted)
+    assert {id(r) for r in drained} == {id(r) for r in submitted}
+
+
+def test_serve_placement_responses_bit_identical(tmp_path, monkeypatch):
+    """The daemon-level A/B invariant: with placement ON, an adversarial
+    backlog of distinct keys is reordered (choices counted) while every
+    response's result fields stay bit-identical to the solo run."""
+    from pluss import obs
+
+    monkeypatch.setenv("PLUSS_SERVE_PLACEMENT", "on")
+    obs.configure(str(tmp_path / "tel.jsonl"))
+    srv = Server(socket_path=str(tmp_path / "p.sock"),
+                 config=ServeConfig(max_batch=1, max_queue=32))
+    srv.start()
+    try:
+        assert srv.batcher.placer is not None
+        reqs = [{"model": m, "n": 16, "threads": 2, "chunk": 2,
+                 "output": "both"} for m in ("gemm", "mvt", "syrk")]
+        with Client(srv.socket_path) as c:
+            solo = {}
+            for qd in reqs:
+                r = c.request(dict(qd))
+                assert r["ok"]
+                solo[qd["model"]] = r
+            hold = c.send({"sleep_ms": 400})
+            time.sleep(0.1)
+            ids = [c.send(dict(qd, id=f"adv{i}-{qd['model']}"))
+                   for i in range(3) for qd in reqs]
+            got = [c.recv(i) for i in ids]
+            c.recv(hold)
+            st = c.request({"op": "stats"})
+        for rid, r in zip(ids, got):
+            assert r["ok"], r
+            model = rid.split("-")[1]
+            assert r["mrc"] == solo[model]["mrc"]
+            assert r["histogram"] == solo[model]["histogram"]
+        assert st["counters"].get("serve.placement.choices", 0) >= 1
+    finally:
+        srv.shutdown(drain_timeout_s=30)
+        obs.shutdown()
+
+
+def test_serve_placement_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("PLUSS_SERVE_PLACEMENT", raising=False)
+    srv = Server(socket_path=str(tmp_path / "q.sock"),
+                 config=ServeConfig(max_batch=1, max_queue=8))
+    srv.start()
+    try:
+        assert srv.batcher.placer is None
+    finally:
+        srv.shutdown(drain_timeout_s=30)
+
+
+def test_stats_placement_breakdown():
+    from pluss.obs import stats as stats_mod
+
+    lines = stats_mod.placement_breakdown(
+        {"serve.placement.choices": 5.0, "serve.placement.reorders": 2.0,
+         "serve.placement.memo_hits": 4.0,
+         "serve.placement.head_rescues": 1.0,
+         "serve.placement.errors": 1.0},
+        {"serve.placement.last_cost": 0.25})
+    assert lines[0] == "interference-aware placement:"
+    assert any("(2 reordered)" in ln for ln in lines)
+    assert any("memo hits" in ln for ln in lines)
+    assert any("rescues" in ln for ln in lines)
+    assert any("last pair cost" in ln for ln in lines)
+    assert any("errors" in ln for ln in lines)
+    assert stats_mod.placement_breakdown({}, {}) == []
+
+
+# ---------------------------------------------------------------------------
+# docs sync
+
+
+def test_readme_documents_tune_and_placement():
+    """The README's PL9xx rows carry the EMITTED severities, the knob
+    table names the placement knob with its real default, and the
+    search-space table shows the CLI's actual axis defaults."""
+    import os
+    import re
+
+    readme = open(os.path.join(os.path.dirname(__file__), "..",
+                               "README.md")).read()
+    rows = dict(re.findall(r"^\| (PL9\d{2}) \| (\w+) \|", readme,
+                           flags=re.M))
+    assert rows == {"PL901": "info", "PL902": "info",
+                    "PL903": "warning", "PL904": "error"}
+    assert "## Schedule tuning & placement: `pluss tune`" in readme
+    assert re.search(r"^\| `PLUSS_SERVE_PLACEMENT` \| `off` \|", readme,
+                     flags=re.M), "placement knob row with its default"
+    # search-space defaults match the CLI parser's
+    assert "`1,2,4,8`" in readme and "`1,4,16`" in readme
+    for counter in ("serve.placement.choices", "placement.last_cost",
+                    "head_rescues"):
+        assert counter.split(".")[-1] in readme
