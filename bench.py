@@ -1134,6 +1134,68 @@ def bench_tune(n: int = 128) -> None:
     }), flush=True)
 
 
+def bench_transform(n: int = 64) -> None:
+    """Loop-transformation headlines (round r18 on): wall time of the
+    full transform-space search (`pluss tune --transforms` —
+    pluss/analysis/transform.py: legality proofs over the dependence
+    vectors, then one tune pass per proven-legal transform) on gemm at a
+    1 KB LLC, with the engine dispatch counter witnessing the search is
+    host math; plus the headline the search exists to find — the static
+    LLC miss-ratio delta of the best proven-legal tiled schedule vs the
+    untransformed PL901 winner (negative = the transform wins)."""
+    from pluss import engine
+    from pluss.analysis import transform as tf
+    from pluss.analysis import tune as tune_mod
+    from pluss.model import hierarchy as hier_mod
+    from pluss.models import gemm
+
+    spec = gemm(n)
+    hier = hier_mod.HierarchyConfig(levels_kb=(1,), assoc=0, policy="lru")
+    cands = tune_mod.space((1, 2, 4), (1, 4))
+    d0 = engine.DEVICE_DISPATCHES
+    t0 = time.perf_counter()
+    rep = tf.search_transforms(spec, candidates=cands, hier=hier)
+    dt = time.perf_counter() - t0
+    dispatched = engine.DEVICE_DISPATCHES - d0
+    if dispatched:
+        raise RuntimeError(
+            f"transform search touched the device: {dispatched} "
+            "dispatch(es)")
+    n_legal = sum(1 for e in rep.entries if e.transform.code == "PL951")
+    log(f"bench: transform search gemm{n}: {dt * 1e3:.0f} ms host-only "
+        f"({len(rep.entries)} transform(s), {n_legal} legal, best "
+        f"{rep.best.transform.label() if rep.best else 'identity'}, "
+        f"delta {rep.delta})")
+    print(json.dumps({
+        "metric": "transform_search_ms",
+        "value": round_keep(dt * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": None,
+        "path": "analysis.transform.search_transforms(gemm)",
+        "degradations": [],
+        "spec_source": "registry",
+        "n": n,
+        "transforms": len(rep.entries),
+        "legal": n_legal,
+        "device_dispatches": dispatched,
+    }), flush=True)
+    if rep.best is not None and rep.delta is not None:
+        print(json.dumps({
+            "metric": "gemm_tiled_predicted_mr_delta",
+            "value": round_keep(rep.delta, 9),
+            "unit": "miss_ratio_delta",
+            "vs_baseline": None,
+            "path": "analysis.transform.search_transforms(gemm) best vs "
+                    "untransformed PL901 winner",
+            "degradations": [],
+            "spec_source": "registry",
+            "n": n,
+            "best_transform": rep.best.transform.label(),
+            "best_schedule": rep.best.tune.winner.candidate.label(),
+            "target_kb": rep.target_kb,
+        }), flush=True)
+
+
 def bench_serve_placement(n_requests: int = 48) -> None:
     """Interference-aware placement A/B (round r16 on): client-side p99
     under an ADVERSARIAL co-tenant mix — one tenant's backlog alternating
@@ -1310,6 +1372,11 @@ def main() -> int:
                 bench_tune()
             except Exception as e:
                 log(f"bench: tune metric failed: {e}")
+        if budget_ok("transform", 60):
+            try:
+                bench_transform()
+            except Exception as e:
+                log(f"bench: transform metric failed: {e}")
         if budget_ok("serve_placement", 120):
             try:
                 bench_serve_placement()
@@ -1504,6 +1571,13 @@ def main() -> int:
             bench_tune()
         except Exception as e:
             log(f"bench: tune metric failed: {e}")
+    # transform-space search headline (round r18 on): host-only latency
+    # + the best tiled schedule's static LLC miss-ratio delta
+    if budget_ok("transform", 60):
+        try:
+            bench_transform()
+        except Exception as e:
+            log(f"bench: transform metric failed: {e}")
     if budget_ok("serve_placement", 120):
         try:
             bench_serve_placement()

@@ -43,6 +43,14 @@ Code families mirror the analyzer's four passes:
   off the derivability ladder (PL903 — the PL701/702 cause chain
   attaches), and the ``--check`` cross-validation alarm when a live
   engine run disagrees with the predicted winner (PL904).
+- ``PL95x`` transform (:mod:`pluss.analysis.transform`): the
+  loop-transformation legality prover — proven-legal transform with the
+  witness dependence vectors (PL951), proven-illegal with the concrete
+  violating pair (PL952), typed refusal when the nest is outside the
+  dependence-vector contract (PL953 — the PL601/PL701 cause chain
+  attaches, never a silent guess), and the transform ``--check``
+  cross-validation alarm when a live engine run of the transformed spec
+  disagrees with its static MRC prediction (PL954).
 
 Severity semantics: ERROR means the spec is wrong (out-of-bounds access,
 undeclared array, contract violation) — ``pluss lint`` exits nonzero.
@@ -152,6 +160,18 @@ CODES: dict[str, tuple[str, str]] = {
     "PL904": ("tuning", "tuned-winner cross-check alarm: live engine run "
                         "disagrees with the predicted MRC beyond the "
                         "epsilon"),
+    "PL951": ("transform", "transform proven legal: every dependence "
+                           "vector stays lexicographically nonnegative "
+                           "(witness vectors attached)"),
+    "PL952": ("transform", "transform proven illegal: a dependence "
+                           "vector would be reversed (concrete violating "
+                           "pair attached)"),
+    "PL953": ("transform", "transform refused: nest outside the "
+                           "dependence-vector contract (PL601/PL701 "
+                           "cause chain attached, never a silent guess)"),
+    "PL954": ("transform", "transformed-spec cross-check alarm: live "
+                           "engine run disagrees with the static MRC "
+                           "prediction beyond the epsilon"),
 }
 
 

@@ -1,0 +1,821 @@
+"""Loop-transformation legality prover + spec-to-spec transformer.
+
+PR 16's ``pluss tune`` optimizes the *runtime* knobs of a frozen nest;
+this module moves the nest itself: the classic locality levers —
+**interchange**, **tiling**, **fusion** — proven legal (or illegal, or
+refused) from the dependence direction vectors of
+:mod:`pluss.analysis.depvec` and applied as a pure spec-to-spec rewrite,
+so every transformed nest is an ordinary :class:`~pluss.spec.
+LoopNestSpec` that rides the whole existing stack unchanged: lint, the
+PR-12 static predictor, the PR-15 hierarchy read-offs, ``pluss serve``
+registration, and the engine ``--check`` bit-identity gate.
+
+Legality rules (the textbook conditions, each carrying its proof):
+
+========== ==============================================================
+interchange legal iff, after permuting the band positions, every
+            dependence direction vector stays lexicographically
+            nonnegative (a reversed vector would run a sink before its
+            source).
+tiling      single-level tiling is strip-mining — the iteration ORDER is
+            unchanged, always legal under the rectangular/divisibility
+            contract.  Multi-level tiling hoists tile loops above the
+            band and is legal iff the band is FULLY PERMUTABLE: every
+            vector with all-zero components before the band has
+            nonnegative components throughout the band (Wolf–Lam).
+fusion      legal iff no fusion-preventing backward dependence: a
+            cross-nest conflict whose later-nest instance sits at a
+            strictly smaller outer index would, after fusing, run
+            before its source.
+========== ==============================================================
+
+Imperfect nests are first PERFECTIZED by loop distribution (gemm's
+``i{j{C0,C1,k{...}}}`` splits into ``i{j{C0,C1}}`` + ``i{j{k{...}}}``),
+itself proven legal (no dependence from a later body group back into an
+earlier one) — so ``pluss transform gemm --interchange 0,2`` is the real
+compiler composite distribute-then-permute, not a toy.
+
+Verdicts are typed, never a silent guess: PL951 proven-legal (the
+re-checked witness vectors attach), PL952 proven-illegal (the concrete
+violating instance pair attaches — a brute-force iteration-space oracle
+confirms it in tests), PL953 typed refusal chaining the PL601/PL701
+causes when a nest is outside the dependence-vector contract.  PL954 is
+the transform ``--check`` alarm: the live engine run of the transformed
+spec disagrees with its static MRC prediction.
+
+``search_transforms`` extends the PL901 dominance-pruned tune search
+over the transform space — interchange pairs, a tile-size ladder derived
+per declared memory level, adjacent fusions — and reports the
+proven-best *transformed* schedule with its static MRC delta against the
+untransformed winner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from pluss.analysis import depvec
+from pluss.analysis import ri as ri_mod
+from pluss.analysis import tune as tune_mod
+from pluss.analysis.diagnostics import Diagnostic, Severity, shown
+from pluss.config import DEFAULT, SamplerConfig
+from pluss.model import hierarchy as hier_mod
+from pluss import spec as spec_mod
+from pluss.spec import Loop, LoopNestSpec, Ref
+
+
+# --- report ----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TransformReport:
+    """One transform request's proof record: the typed verdict, the
+    transformed spec when legal, the checked dependence vectors, and —
+    for PL952 — the concrete violating instance pair."""
+
+    model: str
+    kind: str                       # "interchange" | "tile" | "fuse"
+    params: dict
+    code: str                       # PL951 | PL952 | PL953
+    spec: LoopNestSpec | None
+    diagnostics: list[Diagnostic]
+    edges: list[dict]               # docs of every dependence edge checked
+    violation: dict | None = None   # PL952: the violating pair + witness
+    provenance: dict | None = None  # instance mapping back to the original
+
+    def label(self) -> str:
+        if self.kind == "interchange":
+            return f"interchange({self.params['a']},{self.params['b']})"
+        if self.kind == "tile":
+            t = ",".join(f"{l}:{s}" for l, s in self.params["tiles"])
+            return f"tile({t})"
+        return f"fuse({self.params['a']}+{self.params['b']})"
+
+    def doc(self) -> dict:
+        from pluss import spec_codec
+
+        d = {"model": self.model, "kind": self.kind,
+             "params": self.params, "verdict": self.code,
+             "edges": self.edges,
+             "diagnostics": [g.to_dict() for g in self.diagnostics]}
+        if self.violation is not None:
+            d["violation"] = self.violation
+        if self.provenance is not None:
+            d["provenance"] = self.provenance
+        if self.spec is not None:
+            d["spec"] = spec_codec.spec_to_json(self.spec)
+        return d
+
+
+def _refuse(spec: LoopNestSpec, kind: str, params: dict,
+            cause: str) -> TransformReport:
+    return TransformReport(spec.name, kind, params, "PL953", None, [
+        Diagnostic("PL953", Severity.WARNING,
+                   f"{kind} refused: {cause}")], [])
+
+
+def _illegal(spec: LoopNestSpec, kind: str, params: dict, edge: depvec.
+             DepEdge, why: str, extra: dict | None = None
+             ) -> TransformReport:
+    viol = dict(edge.doc())
+    if extra:
+        viol.update(extra)
+    return TransformReport(spec.name, kind, params, "PL952", None, [
+        Diagnostic(
+            "PL952", Severity.ERROR,
+            f"{kind} proven illegal: {why} — violating pair "
+            f"{edge.src.ref.name}@{list(edge.src_iv)} -> "
+            f"{edge.dst.ref.name}@{list(edge.dst_iv)} "
+            f"({edge.kind}, dir {list(edge.sigma)})")],
+        [edge.doc()], violation=viol)
+
+
+# --- tree rewriting helpers ------------------------------------------------
+
+
+def _band_chain(nest: Loop, b: int) -> list[Loop] | None:
+    """Loops at levels 0..b when the nest is perfect through level b-1
+    (single-Loop bodies), else None."""
+    chain, cur = [], nest
+    for lvl in range(b + 1):
+        chain.append(cur)
+        if lvl == b:
+            break
+        if len(cur.body) != 1 or not isinstance(cur.body[0], Loop):
+            return None
+        cur = cur.body[0]
+    return chain
+
+
+def _rewrite_terms(item, fn):
+    """Map every ref's addr terms through ``fn(depth, coef) ->
+    [(depth', coef'), ...]``, recursively."""
+    if isinstance(item, Ref):
+        terms: list[tuple[int, int]] = []
+        for d, c in item.addr_terms:
+            terms += fn(d, c)
+        return dataclasses.replace(
+            item, addr_terms=tuple(sorted(terms)))
+    return dataclasses.replace(
+        item, body=tuple(_rewrite_terms(x, fn) for x in item.body))
+
+
+def _distribute(loop: Loop, levels: int) -> list[Loop]:
+    """Perfectize ``loop`` through ``levels`` band levels by loop
+    distribution: each maximal run of Refs and each Loop child of an
+    imperfect body becomes its own copy of the enclosing chain.  Returns
+    the distributed nests in program order (a single element when the
+    nest was already perfect)."""
+    if levels == 0 or all(isinstance(x, Ref) for x in loop.body):
+        return [loop]
+    if len(loop.body) == 1 and isinstance(loop.body[0], Loop):
+        return [dataclasses.replace(loop, body=(sub,))
+                for sub in _distribute(loop.body[0], levels - 1)]
+    out: list[Loop] = []
+    run: list[Ref] = []
+    for x in loop.body:
+        if isinstance(x, Ref):
+            run.append(x)
+            continue
+        if run:
+            out.append(dataclasses.replace(loop, body=tuple(run)))
+            run = []
+        for sub in _distribute(x, levels - 1):
+            out.append(dataclasses.replace(loop, body=(sub,)))
+    if run:
+        out.append(dataclasses.replace(loop, body=tuple(run)))
+    return out
+
+
+def _ref_names(item, acc: list[str]):
+    if isinstance(item, Ref):
+        acc.append(item.name)
+    else:
+        for x in item.body:
+            _ref_names(x, acc)
+
+
+def _group_index(nests: list[Loop]) -> dict[str, int]:
+    """ref name -> distributed-group index (names are unique per nest
+    by the PL406 contract)."""
+    out: dict[str, int] = {}
+    for g, n in enumerate(nests):
+        names: list[str] = []
+        _ref_names(n, names)
+        for nm in names:
+            out[nm] = g
+    return out
+
+
+def _respan(spec: LoopNestSpec) -> LoopNestSpec:
+    """Re-derive every share_span through the PR-8 pipeline: transformed
+    carrying loops get transformed spans, never stale copies."""
+    from pluss.frontend.lower import derive_spans
+
+    def strip(item):
+        if isinstance(item, Ref):
+            return dataclasses.replace(item, share_span=None)
+        return dataclasses.replace(item,
+                                   body=tuple(strip(x) for x in item.body))
+
+    bare = dataclasses.replace(spec, nests=tuple(
+        strip(n) for n in spec.nests))
+    return derive_spans(bare)
+
+
+def _distribution_violation(vectors: depvec.NestVectors,
+                            groups: dict[str, int]) -> depvec.DepEdge | None:
+    """The first dependence edge pointing from a later distributed group
+    back into an earlier one (illegal to split), else None."""
+    for e in vectors.edges:
+        if groups[e.src.ref.name] > groups[e.dst.ref.name]:
+            return e
+    return None
+
+
+# --- interchange -----------------------------------------------------------
+
+
+def interchange(spec: LoopNestSpec, a: int, b: int,
+                nest: int = 0) -> TransformReport:
+    """Swap band levels ``a`` and ``b`` of one nest, distributing first
+    when the nest is imperfect.  Legal iff the distribution is legal and
+    every deep-group dependence vector stays lexicographically
+    nonnegative after the swap."""
+    params = {"a": a, "b": b, "nest": nest}
+    if not (0 <= nest < len(spec.nests)):
+        return _refuse(spec, "interchange", params,
+                       f"nest {nest} does not exist")
+    if not (0 <= a < b):
+        return _refuse(spec, "interchange", params,
+                       "need band levels 0 <= a < b")
+    vectors = depvec.nest_vectors(spec, nest)
+    if vectors.refused is not None:
+        return _refuse(spec, "interchange", params, vectors.refused)
+    if spec_mod.nest_depth(spec.nests[nest]) <= b:
+        return _refuse(spec, "interchange", params,
+                       f"nest {nest} has no level {b}")
+    dist = _distribute(spec.nests[nest], b)
+    groups = _group_index(dist)
+    bad = _distribution_violation(vectors, groups)
+    if bad is not None:
+        return _illegal(spec, "interchange", params, bad,
+                        "perfectizing distribution would run the sink "
+                        "group before its source group")
+    checked: list[dict] = []
+    for e in vectors.edges:
+        if groups[e.src.ref.name] != groups[e.dst.ref.name]:
+            continue  # cross-group: order fixed by the nest sequence
+        if len(e.sigma) <= b:
+            continue  # shallow group: does not contain the band
+        perm = list(e.sigma)
+        perm[a], perm[b] = perm[b], perm[a]
+        doc = dict(e.doc())
+        doc["permuted"] = perm
+        checked.append(doc)
+        if depvec._lex(tuple(perm)) < 0:
+            return _illegal(
+                spec, "interchange", params, e,
+                f"direction vector {list(e.sigma)} becomes "
+                f"lexicographically negative {perm} after the swap",
+                extra={"permuted": perm})
+
+    def swap_term(d, c):
+        nd = b if d == a else a if d == b else d
+        return [(nd, c)]
+
+    new_nests: list[Loop] = []
+    prov_nests: list[dict] = []
+    for gi, g in enumerate(dist):
+        chain = _band_chain(g, b)
+        if chain is None:   # shallow ref-run group: untouched
+            new_nests.append(g)
+            prov_nests.append({"orig_nest": nest, "map": "identity"})
+            continue
+        body = tuple(_rewrite_terms(x, swap_term) for x in chain[b].body)
+        for lvl in range(b, -1, -1):
+            # positions a and b exchange their loop parameters
+            src = chain[b] if lvl == a else chain[a] if lvl == b \
+                else chain[lvl]
+            body = (dataclasses.replace(src, body=body),)
+        new_nests.append(body[0])
+        perm = list(range(b + 1))
+        perm[a], perm[b] = perm[b], perm[a]
+        prov_nests.append({"orig_nest": nest, "map": "interchange",
+                           "a": a, "b": b, "perm": perm})
+    tspec = dataclasses.replace(
+        spec, name=f"{spec.name}_ic{a}{b}",
+        nests=spec.nests[:nest] + tuple(new_nests)
+        + spec.nests[nest + 1:])
+    tspec = _respan(tspec)
+    prov = {"kind": "interchange", "params": params, "nests": (
+        [{"orig_nest": i, "map": "identity"} for i in range(nest)]
+        + prov_nests
+        + [{"orig_nest": i, "map": "identity"}
+           for i in range(nest + 1, len(spec.nests))])}
+    n_dist = len(dist)
+    diags = [Diagnostic(
+        "PL951", Severity.INFO,
+        f"interchange({a},{b}) proven legal on nests[{nest}]"
+        + (f" after distribution into {n_dist} nests" if n_dist > 1
+           else "")
+        + f": {len(checked)} dependence vector(s) re-checked, all stay "
+        f"lexicographically nonnegative "
+        f"[{shown([str(c['vector']) for c in checked]) or 'none'}]")]
+    return TransformReport(spec.name, "interchange", params, "PL951",
+                           tspec, diags, checked, provenance=prov)
+
+
+# --- tiling ----------------------------------------------------------------
+
+
+def tile(spec: LoopNestSpec, tiles: list[tuple[int, int]],
+         nest: int = 0) -> TransformReport:
+    """Tile a contiguous band of levels with per-level sizes.  The tile
+    loop keeps the original start and steps by ``step*size``; the point
+    loop spans ``[0, size)`` with the original step, so per-instance
+    addresses are bit-identical.  Single-level = strip-mining (order-
+    preserving); multi-level requires the band fully permutable."""
+    tiles = sorted(tiles)
+    params = {"tiles": [list(t) for t in tiles], "nest": nest}
+    if not tiles:
+        return _refuse(spec, "tile", params, "no tile levels given")
+    levels = [l for l, _ in tiles]
+    a, b = levels[0], levels[-1]
+    if levels != list(range(a, b + 1)):
+        return _refuse(spec, "tile", params,
+                       f"tile levels {levels} are not a contiguous band")
+    if a < 0:
+        return _refuse(spec, "tile", params, "negative tile level")
+    if not (0 <= nest < len(spec.nests)):
+        return _refuse(spec, "tile", params, f"nest {nest} does not exist")
+    vectors = depvec.nest_vectors(spec, nest)
+    if vectors.refused is not None:
+        return _refuse(spec, "tile", params, vectors.refused)
+    if spec_mod.nest_depth(spec.nests[nest]) <= b:
+        return _refuse(spec, "tile", params, f"nest {nest} has no "
+                       f"level {b}")
+    dist = _distribute(spec.nests[nest], b)
+    groups = _group_index(dist)
+    bad = _distribution_violation(vectors, groups)
+    if bad is not None:
+        return _illegal(spec, "tile", params, bad,
+                        "perfectizing distribution would run the sink "
+                        "group before its source group")
+    sizes = {l: s for l, s in tiles}
+    for g in dist:
+        chain = _band_chain(g, b)
+        if chain is None:
+            continue
+        for l in range(a, b + 1):
+            t, s = chain[l].trip, sizes[l]
+            if s < 2 or s >= t or t % s:
+                return _refuse(
+                    spec, "tile", params,
+                    f"tile size {s} at level {l} must satisfy "
+                    f"2 <= size < trip and divide trip ({t})")
+    checked: list[dict] = []
+    if b > a:   # multi-level: band must be fully permutable
+        for e in vectors.edges:
+            if groups[e.src.ref.name] != groups[e.dst.ref.name]:
+                continue
+            if len(e.sigma) <= b:
+                continue
+            doc = dict(e.doc())
+            checked.append(doc)
+            if all(s == 0 for s in e.sigma[:a]) \
+                    and any(s < 0 for s in e.sigma[a:b + 1]):
+                return _illegal(
+                    spec, "tile", params, e,
+                    f"band [{a},{b}] is not fully permutable: vector "
+                    f"{list(e.sigma)} has a negative component inside "
+                    "the band with no positive component before it")
+    width = b - a + 1
+
+    def tile_term(d, c):
+        if d < a:
+            return [(d, c)]
+        if d <= b:
+            return [(a + (d - a), c), (b + 1 + (d - a), c)]
+        return [(d + width, c)]
+
+    new_nests: list[Loop] = []
+    prov_nests: list[dict] = []
+    for g in dist:
+        chain = _band_chain(g, b)
+        if chain is None:
+            new_nests.append(g)
+            prov_nests.append({"orig_nest": nest, "map": "identity"})
+            continue
+        body = tuple(_rewrite_terms(x, tile_term) for x in chain[b].body)
+        for l in range(b, a - 1, -1):   # point loops, innermost first
+            s = sizes[l]
+            body = (Loop(trip=s, body=body, start=0, step=chain[l].step),)
+        for l in range(b, a - 1, -1):   # tile loops above them
+            s = sizes[l]
+            body = (dataclasses.replace(
+                chain[l], trip=chain[l].trip // s,
+                step=chain[l].step * s, body=body),)
+        for l in range(a - 1, -1, -1):  # untouched outer levels
+            body = (dataclasses.replace(chain[l], body=body),)
+        new_nests.append(body[0])
+        prov_nests.append({"orig_nest": nest, "map": "tile", "a": a,
+                           "b": b, "sizes": [sizes[l]
+                                             for l in range(a, b + 1)]})
+    suffix = "_".join(f"{l}x{s}" for l, s in tiles)
+    tspec = dataclasses.replace(
+        spec, name=f"{spec.name}_tile{suffix}",
+        nests=spec.nests[:nest] + tuple(new_nests)
+        + spec.nests[nest + 1:])
+    tspec = _respan(tspec)
+    prov = {"kind": "tile", "params": params, "nests": (
+        [{"orig_nest": i, "map": "identity"} for i in range(nest)]
+        + prov_nests
+        + [{"orig_nest": i, "map": "identity"}
+           for i in range(nest + 1, len(spec.nests))])}
+    why = ("strip-mine preserves the iteration order" if b == a else
+           f"band [{a},{b}] proven fully permutable over "
+           f"{len(checked)} dependence vector(s)")
+    vecs = shown([str(c["vector"]) for c in checked]) or "no carried vectors"
+    diags = [Diagnostic(
+        "PL951", Severity.INFO,
+        f"tile({','.join(f'{l}:{s}' for l, s in tiles)}) proven legal "
+        f"on nests[{nest}]: {why} [{vecs}]")]
+    return TransformReport(spec.name, "tile", params, "PL951", tspec,
+                           diags, checked, provenance=prov)
+
+
+# --- fusion ----------------------------------------------------------------
+
+
+def fuse(spec: LoopNestSpec, na: int, nb: int) -> TransformReport:
+    """Fuse two ADJACENT nests with identical outer loops.  Legal iff no
+    fusion-preventing backward dependence: a cross-nest conflict whose
+    later-nest instance sits at a strictly smaller outer index."""
+    params = {"a": na, "b": nb}
+    if nb != na + 1 or not (0 <= na and nb < len(spec.nests)):
+        return _refuse(spec, "fuse", params,
+                       "fusion needs two adjacent nests a, a+1")
+    la, lb = spec.nests[na], spec.nests[nb]
+    for ni in (na, nb):
+        v = depvec.nest_vectors(spec, ni)
+        if v.refused is not None:
+            return _refuse(spec, "fuse", params,
+                           f"nests[{ni}]: {v.refused}")
+    if (la.trip, la.start, la.step) != (lb.trip, lb.start, lb.step):
+        return _refuse(
+            spec, "fuse", params,
+            f"outer loops differ: nests[{na}] is (trip={la.trip}, "
+            f"start={la.start}, step={la.step}) vs nests[{nb}] "
+            f"(trip={lb.trip}, start={lb.start}, step={lb.step})")
+    sites_a = [s for s in depvec.ref_sites(spec) if s.nest == na]
+    sites_b = [s for s in depvec.ref_sites(spec) if s.nest == nb]
+    budget = [depvec.vector_budget()]
+    checked: list[dict] = []
+    try:
+        for p in sites_a:
+            for q in sites_b:
+                if p.ref.array != q.ref.array:
+                    continue
+                if not (p.ref.is_write or q.ref.is_write):
+                    continue
+                wit = depvec.fusion_backward_witness(p, q, budget)
+                pair_doc = {"src": p.ref.name, "dst": q.ref.name,
+                            "array": p.ref.array,
+                            "backward": wit is not None}
+                checked.append(pair_doc)
+                if wit is not None:
+                    iv1, iv2 = wit
+                    e = depvec.DepEdge(
+                        p, q, (-1,), (iv2[0] - iv1[0],), iv1, iv2,
+                        depvec._edge_kind(p, q))
+                    return _illegal(
+                        spec, "fuse", params, e,
+                        f"fusion-preventing backward dependence: "
+                        f"nests[{nb}] instance at outer index "
+                        f"{iv2[0]} conflicts with nests[{na}] instance "
+                        f"at outer index {iv1[0]}")
+    except depvec.VectorBudgetExceeded:
+        return _refuse(spec, "fuse", params,
+                       "dependence witness search exceeded the "
+                       "PLUSS_DEPVEC_BUDGET node budget")
+    names_a: list[str] = []
+    _ref_names(la, names_a)
+    renames: dict[str, str] = {}
+
+    def rename(item):
+        if isinstance(item, Ref):
+            if item.name in names_a:
+                new = item.name + "_f"
+                while new in names_a or new in renames:
+                    new += "f"
+                renames[new] = item.name
+                return dataclasses.replace(item, name=new)
+            return item
+        return dataclasses.replace(item,
+                                   body=tuple(rename(x) for x in item.body))
+
+    fused = dataclasses.replace(la, body=la.body + rename(lb).body)
+    tspec = dataclasses.replace(
+        spec, name=f"{spec.name}_fuse{na}{nb}",
+        nests=spec.nests[:na] + (fused,) + spec.nests[nb + 1:])
+    tspec = _respan(tspec)
+    prov = {"kind": "fuse", "params": params, "nests": (
+        [{"orig_nest": i, "map": "identity"} for i in range(na)]
+        + [{"orig_nest": na, "map": "fuse", "other_nest": nb,
+            "names_a": names_a, "renames": renames}]
+        + [{"orig_nest": i, "map": "identity"}
+           for i in range(nb + 1, len(spec.nests))])}
+    diags = [Diagnostic(
+        "PL951", Severity.INFO,
+        f"fuse({na}+{nb}) proven legal: {len(checked)} cross-nest "
+        "conflict pair(s) checked, none carries a backward dependence")]
+    return TransformReport(spec.name, "fuse", params, "PL951", tspec,
+                           diags, checked, provenance=prov)
+
+
+# --- instance mapping (oracle support + provenance doc) --------------------
+
+
+def instance_mapper(prov: dict):
+    """A function mapping a transformed access instance back to its
+    original identity: ``fn(new_nest, ref_name, values) -> (orig_nest,
+    orig_ref_name, orig_values)`` where ``values`` is the per-level loop
+    VALUE vector along the instance's chain.  This is what lets the
+    brute-force test oracle check that every claimed-legal transform
+    preserves the order of every conflicting pair."""
+    nests = prov["nests"]
+
+    def fn(ni: int, name: str, values: tuple):
+        nd = nests[ni]
+        kind = nd["map"]
+        if kind == "identity":
+            return nd["orig_nest"], name, tuple(values)
+        if kind == "interchange":
+            # the permutation is an involution (a swap of a and b)
+            out = list(values)
+            a, b = nd["a"], nd["b"]
+            if len(values) > b:
+                out[a], out[b] = values[b], values[a]
+            return nd["orig_nest"], name, tuple(out)
+        if kind == "tile":
+            a, b = nd["a"], nd["b"]
+            width = b - a + 1
+            out = list(values[:a])
+            for j in range(width):   # value = tile part + point part
+                out.append(values[a + j] + values[a + width + j])
+            out += list(values[a + 2 * width:])
+            return nd["orig_nest"], name, tuple(out)
+        if kind == "fuse":
+            if name in nd["renames"]:
+                return nd["other_nest"], nd["renames"][name], \
+                    tuple(values)
+            if name in nd["names_a"]:
+                return nd["orig_nest"], name, tuple(values)
+            return nd["other_nest"], name, tuple(values)
+        raise ValueError(f"unknown provenance map {kind!r}")
+
+    return fn
+
+
+# --- the transform search (tune --transforms) ------------------------------
+
+
+def tile_ladder(spec: LoopNestSpec, trips: list[int],
+                cfg: SamplerConfig,
+                hier: hier_mod.HierarchyConfig) -> list[int]:
+    """Candidate tile sizes, one rung per declared memory level: the
+    largest power of two whose square working set (per array) fits the
+    level, snapped down to a common divisor of the band trips."""
+    arrays = max(1, len(spec.arrays))
+    sizes: set[int] = set()
+    for kb in hier.levels_kb:
+        cap = kb * 1024 // (cfg.ds * arrays)
+        s = 1
+        while (s * 2) * (s * 2) <= cap:
+            s *= 2
+        while s >= 2 and any(t % s or s >= t for t in trips):
+            s //= 2
+        if s >= 2:
+            sizes.add(s)
+    return sorted(sizes)
+
+
+def enumerate_transforms(spec: LoopNestSpec,
+                         cfg: SamplerConfig = DEFAULT,
+                         hier: hier_mod.HierarchyConfig | None = None,
+                         nest: int = 0) -> list[TransformReport]:
+    """The transform candidate space for one nest: every interchange
+    pair over the deep band, the tile ladder (full-band and innermost
+    strip-mine), and every adjacent fusion.  Returns ALL reports —
+    legal, illegal, and refused — so the search doc shows the whole
+    disposition; only PL951 entries are scored."""
+    hier = hier or hier_mod.HierarchyConfig.from_env()
+    out: list[TransformReport] = []
+    depth = spec_mod.nest_depth(spec.nests[nest]) if spec.nests else 0
+    for a in range(depth):
+        for b in range(a + 1, depth):
+            out.append(interchange(spec, a, b, nest=nest))
+    # the primary chain: follow the unique Loop child at each level
+    chain_trips: list[int] = []
+    item = spec.nests[nest] if spec.nests else None
+    while isinstance(item, Loop):
+        chain_trips.append(item.trip)
+        loops = [x for x in item.body if isinstance(x, Loop)]
+        item = loops[0] if len(loops) == 1 else None
+    band = list(range(min(depth, len(chain_trips))))
+    if len(band) >= 2:
+        trips = [chain_trips[l] for l in band]
+        for s in tile_ladder(spec, trips, cfg, hier):
+            out.append(tile(spec, [(l, s) for l in band], nest=nest))
+    if depth >= 1 and chain_trips:
+        for s in tile_ladder(spec, chain_trips[-1:], cfg, hier):
+            out.append(tile(spec, [(len(chain_trips) - 1, s)],
+                            nest=nest))
+    for na in range(len(spec.nests) - 1):
+        out.append(fuse(spec, na, na + 1))
+    # dedupe by label (full-band tile can coincide with strip-mine)
+    seen: set[str] = set()
+    uniq: list[TransformReport] = []
+    for r in out:
+        if r.label() not in seen:
+            seen.add(r.label())
+            uniq.append(r)
+    return uniq
+
+
+@dataclasses.dataclass
+class TransformEntry:
+    transform: TransformReport
+    tune: tune_mod.TuneReport | None    # None unless PL951 + derivable
+
+    def score(self) -> float | None:
+        if self.tune is not None and self.tune.winner is not None:
+            return self.tune.winner.score
+        return None
+
+    def doc(self) -> dict:
+        d = {"transform": self.transform.label(),
+             "verdict": self.transform.code}
+        if self.tune is not None:
+            d["tune"] = {"verdict": self.tune.code,
+                         "winner": (self.tune.winner.doc()
+                                    if self.tune.winner else None)}
+        if self.score() is not None:
+            d["score"] = self.score()
+        return d
+
+
+@dataclasses.dataclass
+class TransformTuneReport:
+    """``pluss tune --transforms``: the schedule search re-run per legal
+    transform, with the static MRC delta against the untransformed
+    winner."""
+
+    model: str
+    target_kb: int
+    hier: hier_mod.HierarchyConfig
+    base: tune_mod.TuneReport
+    entries: list[TransformEntry]
+    best: TransformEntry | None      # None = identity wins (or refusal)
+    delta: float | None              # best score - identity score (<0 win)
+    diagnostics: list[Diagnostic]
+
+    def best_spec(self) -> LoopNestSpec | None:
+        return self.best.transform.spec if self.best else None
+
+    def doc(self) -> dict:
+        d = {"model": self.model, "target_kb": self.target_kb,
+             "base": self.base.doc(),
+             "transforms": [e.doc() for e in self.entries],
+             "diagnostics": [g.to_dict() for g in self.diagnostics]}
+        if self.best is not None:
+            d["best"] = self.best.doc()
+            d["best_transform"] = self.best.transform.label()
+        if self.delta is not None:
+            d["delta"] = self.delta
+        return d
+
+
+def search_transforms(spec: LoopNestSpec,
+                      base_cfg: SamplerConfig = DEFAULT,
+                      candidates: list[tune_mod.Candidate] | None = None,
+                      hier: hier_mod.HierarchyConfig | None = None,
+                      budget: int | None = None) -> TransformTuneReport:
+    """Extend the PL901 dominance-pruned schedule search over the
+    transform space: tune the untransformed spec, then every proven-
+    legal transformed spec, and report the best (transform, schedule)
+    pair with its static LLC miss-ratio delta."""
+    hier = hier or hier_mod.HierarchyConfig.from_env()
+    base = tune_mod.tune(spec, base_cfg, candidates, hier, budget)
+    entries: list[TransformEntry] = []
+    for tr in enumerate_transforms(spec, base_cfg, hier):
+        if tr.code != "PL951" or tr.spec is None:
+            entries.append(TransformEntry(tr, None))
+            continue
+        rep = tune_mod.tune(tr.spec, base_cfg, candidates, hier, budget)
+        entries.append(TransformEntry(tr, rep))
+    base_score = base.winner.score if base.winner is not None else None
+    scored = [e for e in entries if e.score() is not None]
+    best = min(scored, key=lambda e: e.score()) if scored else None
+    delta = None
+    diags: list[Diagnostic] = []
+    if best is not None and base_score is not None:
+        delta = best.score() - base_score
+        if delta < -tune_mod.TIE_EPS:
+            diags.append(Diagnostic(
+                "PL901", Severity.INFO,
+                f"proven-best transformed schedule: "
+                f"{best.transform.label()} + "
+                f"{best.tune.winner.candidate.label()} predicts miss "
+                f"{best.score():.6g} at {base.target_kb} KB LLC — "
+                f"{-delta:.6g} below the untransformed winner "
+                f"({base_score:.6g})"))
+        else:
+            best = None
+            diags.append(Diagnostic(
+                "PL901", Severity.INFO,
+                f"no transform beats the untransformed winner "
+                f"(best transformed score within epsilon of "
+                f"{base_score:.6g}); keeping the identity schedule"))
+    elif base_score is None:
+        diags.append(Diagnostic(
+            "PL903", Severity.WARNING,
+            "transform search refused: the untransformed tune fell off "
+            "the derivability ladder"))
+    n_legal = sum(1 for e in entries if e.transform.code == "PL951")
+    diags.append(Diagnostic(
+        "PL951", Severity.INFO,
+        f"transform space: {len(entries)} candidate(s), {n_legal} "
+        f"proven legal, "
+        f"{sum(1 for e in entries if e.transform.code == 'PL952')} "
+        f"proven illegal, "
+        f"{sum(1 for e in entries if e.transform.code == 'PL953')} "
+        "refused"))
+    return TransformTuneReport(spec.name, base.target_kb, hier, base,
+                               entries, best, delta, diags)
+
+
+# --- the --check cross-validation (PL954) ----------------------------------
+
+
+def check_transform(report: TransformReport,
+                    cfg: SamplerConfig = DEFAULT,
+                    budget: int | None = None
+                    ) -> tuple[bool, dict, list[Diagnostic]]:
+    """Run the live engine ONCE on the transformed spec and require its
+    static MRC prediction to match bit-identically (closed-form rungs)
+    or within :data:`~pluss.analysis.ri.MRC_EPS` (dense).  Disagreement
+    is the PL954 alarm.  A prediction refusal is reported as a skip
+    (ok, with the refusal codes in the detail), mirroring ``pluss
+    predict``'s ladder semantics."""
+    from pluss import engine
+
+    if report.spec is None:
+        raise ValueError("check_transform: no transformed spec "
+                         f"(verdict {report.code})")
+    rep = ri_mod.predict(report.spec, cfg, budget=budget)
+    if rep.rihist is None:
+        codes = sorted({d.code for d in rep.prediction.diagnostics})
+        return True, {"skipped": True, "codes": codes}, []
+    res = engine.run(report.spec, cfg)
+    ok, detail = ri_mod.check_against_engine(rep, res, cfg)
+    diags: list[Diagnostic] = []
+    if not ok:
+        diags.append(Diagnostic(
+            "PL954", Severity.ERROR,
+            f"transformed-spec cross-check failed for "
+            f"{report.label()} on {report.model}: live engine run "
+            f"disagrees with the static MRC prediction beyond "
+            f"{ri_mod.MRC_EPS:g} ({detail})"))
+    return ok, detail, diags
+
+
+# --- CLI parameter parsing -------------------------------------------------
+
+
+def parse_interchange(text: str) -> tuple[int, int]:
+    """``"0,2"`` -> (0, 2)."""
+    parts = text.split(",")
+    if len(parts) != 2:
+        raise ValueError("--interchange wants 'a,b' (two band levels)")
+    return int(parts[0]), int(parts[1])
+
+
+def parse_tile(text: str) -> list[tuple[int, int]]:
+    """``"0:8,1:8"`` -> [(0, 8), (1, 8)]."""
+    tiles = []
+    for part in text.split(","):
+        if ":" not in part:
+            raise ValueError("--tile wants 'level:size[,level:size...]'")
+        l, s = part.split(":", 1)
+        tiles.append((int(l), int(s)))
+    return tiles
+
+
+def parse_fuse(text: str) -> tuple[int, int]:
+    """``"0+1"`` -> (0, 1)."""
+    parts = text.split("+")
+    if len(parts) != 2:
+        raise ValueError("--fuse wants 'a+b' (two adjacent nest indices)")
+    return int(parts[0]), int(parts[1])

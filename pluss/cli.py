@@ -153,6 +153,7 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None,
     footprints: dict[str, dict] = {}
     predictions: dict[str, dict] = {}
     hierarchies: dict[str, dict] = {}
+    depvectors: dict[str, dict] = {}
     errors = 0
     for name, spec in targets:
         if cfg is None:
@@ -161,6 +162,15 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None,
             diags, fp = analysis.analyze_spec(spec, cfg)
             footprints[spec.name] = _footprint_doc(
                 fp, analysis.footprint.mrc_bracket(spec, cfg, fp))
+            # per-pair dependence direction/distance vectors (pluss/
+            # analysis/depvec.py): the PL301/302 race findings get the
+            # vector evidence that justified them appended, and the
+            # transform prover's raw material lands on the doc
+            from pluss.analysis import depvec as depvec_mod
+
+            vecs = depvec_mod.spec_vectors(spec)
+            depvectors[spec.name] = depvec_mod.doc_of(vecs)
+            diags = depvec_mod.annotate_races(diags, vecs)
             # the symbolic reuse-interval verdict rides the analyze
             # report: derivability, method, and the exact plateau next to
             # the heuristic bracket above (PL704 = soundness alarm)
@@ -194,6 +204,7 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None,
             doc["footprint"] = footprints
             doc["prediction"] = predictions
             doc["hierarchy"] = hierarchies
+            doc["depvectors"] = depvectors
         out.write(json_mod.dumps(doc, indent=1) + "\n")
     else:
         text = analysis.format_text(all_diags)
@@ -216,6 +227,11 @@ def _lint_main(args, out, cfg: SamplerConfig | None = None,
                             hierarchies[name], indent="    "):
                         out.write(f"  {line}\n" if line == "hierarchy:"
                                   else f"{line}\n")
+                if name in depvectors:
+                    from pluss.analysis import depvec as depvec_mod
+
+                    for line in depvec_mod.render(depvectors[name]):
+                        out.write(f"  {line}\n")
         n_warn = sum(1 for d in all_diags
                      if d.severity is analysis.Severity.WARNING)
         out.write(f"pluss {mode}: {len(targets)} model(s), {errors} "
@@ -432,6 +448,13 @@ def _tune_main(args, p, out, setup_platform) -> int:
         p.error("tune mode: malformed --sweep-threads/--sweep-chunks "
                 "(want comma-separated ints)")
     cands = tune_mod.space(ts, cks, (args.window,), (args.share_cap,))
+    if args.transforms:
+        # the PR-18 extension: search (transform, schedule) pairs, not
+        # just schedules — one model at a time (the space is per-spec)
+        if args.all:
+            p.error("tune mode: --transforms wants a single model, "
+                    "not --all")
+        return _tune_transforms(args, out, setup_platform, cands, hier)
     if args.all:
         targets = [(nm, REGISTRY[nm](args.n)) for nm in sorted(REGISTRY)]
     else:
@@ -491,6 +514,165 @@ def _tune_main(args, p, out, setup_platform) -> int:
                   f"{len(cands)} candidate(s) at "
                   f"{reports[0][2].target_kb} KB LLC: {n_best} "
                   f"proven-best, {n_tie} tie(s), {n_ref} refused\n")
+    return rc
+
+
+def _tune_transforms(args, out, setup_platform, cands, hier) -> int:
+    """``pluss tune --transforms <model>`` — extend the PL901 dominance-
+    pruned schedule search over the legal transform space (:mod:`pluss.
+    analysis.transform`): every proven-legal interchange / hierarchy-
+    laddered tiling / fusion of the model is tuned at the declared LLC,
+    and the best (transform, schedule) pair is reported with its static
+    MRC delta against the untransformed winner.  ``--check`` cross-
+    validates that winner with ONE engine run of the TRANSFORMED spec
+    (the only device work in this mode)."""
+    import json as json_mod
+
+    from pluss import analysis
+    from pluss.analysis import transform as tf
+    from pluss.analysis import tune as tune_mod
+
+    spec = REGISTRY[args.model](args.n)
+    rep = tf.search_transforms(spec, candidates=cands, hier=hier)
+    doc = rep.doc()
+    all_diags = analysis.with_model(rep.diagnostics, spec.name)
+    rc = 1 if any(d.code == "PL903" for d in rep.diagnostics) else 0
+    if args.check and rep.best is not None:
+        # one live engine run of the winning TRANSFORMED spec under its
+        # tuned schedule — bit-identity or PL904, like plain tune
+        setup_platform()
+        ok, detail, diags = tune_mod.check_winner(
+            rep.best.transform.spec, rep.best.tune)
+        doc["check"] = detail
+        all_diags += analysis.with_model(diags, spec.name)
+        if not ok:
+            rc = 1
+            print(f"pluss tune: {spec.name}: transformed winner CHECK "
+                  f"FAILED (PL904) {detail}", file=sys.stderr)
+        else:
+            kind = "bit-identical" if detail["mrc_exact"] \
+                else f"l2={detail['mrc_l2_error']:.2e}"
+            print(f"pluss tune: {spec.name}: transformed winner "
+                  f"{rep.best.transform.label()} + "
+                  f"{rep.best.tune.winner.candidate.label()} verified "
+                  f"against engine.run (histograms bit-identical, MRC "
+                  f"{kind})", file=sys.stderr)
+    elif args.check:
+        print(f"pluss tune: {spec.name}: transform check skipped (no "
+              "transform beats the untransformed winner)",
+              file=sys.stderr)
+    if args.sarif:
+        from pluss.analysis import sarif as sarif_mod
+
+        sarif_mod.write_sarif(args.sarif, all_diags)
+        print(f"pluss tune: SARIF log at {args.sarif}", file=sys.stderr)
+    if args.json:
+        out.write(json_mod.dumps(doc, indent=1) + "\n")
+    else:
+        for d in rep.diagnostics:
+            out.write(f"{spec.name}: [{d.code}] {d.message}\n")
+        if rep.best is not None:
+            out.write(f"pluss tune: {spec.name}: best transform "
+                      f"{rep.best.transform.label()} + "
+                      f"{rep.best.tune.winner.candidate.label()} "
+                      f"(predicted miss {rep.best.score():.6g}, delta "
+                      f"{rep.delta:+.6g}) at {rep.target_kb} KB LLC\n")
+        else:
+            out.write(f"pluss tune: {spec.name}: no transform beats "
+                      f"the untransformed winner at {rep.target_kb} KB "
+                      "LLC\n")
+    return rc
+
+
+def _transform_main(args, p, out, setup_platform) -> int:
+    """``pluss transform <model> (--interchange A,B | --tile L:S,... |
+    --fuse A+B) [--json|--sarif|--check|--register]`` — the proof-
+    carrying loop-transformation prover and spec-to-spec transformer
+    (:mod:`pluss.analysis.transform`).  Typed verdicts: PL951 proven
+    legal (the transformed nest is an ordinary LoopNestSpec —
+    printable, registerable, servable), PL952 proven illegal with the
+    concrete violating pair, PL953 typed refusal; rc 0 only on PL951.
+    ``--check`` runs the TRANSFORMED spec once through the live engine
+    and requires the static MRC prediction to match bit-identically
+    (PL954 alarm otherwise)."""
+    import json as json_mod
+
+    from pluss import analysis, spec_codec
+    from pluss.analysis import transform as tf
+
+    if not args.target:
+        p.error("transform mode requires a model (e.g. `pluss "
+                "transform gemm --interchange 0,2`)")
+    if args.target not in REGISTRY:
+        p.error(f"transform mode: unknown model {args.target!r}")
+    picked = [f for f in (args.interchange, args.tile, args.fuse)
+              if f is not None]
+    if len(picked) != 1:
+        p.error("transform mode wants exactly one of "
+                "--interchange/--tile/--fuse")
+    spec = REGISTRY[args.target](args.n)
+    cfg = SamplerConfig(thread_num=args.threads, chunk_size=args.chunk)
+    try:
+        if args.interchange is not None:
+            a, b = tf.parse_interchange(args.interchange)
+            rep = tf.interchange(spec, a, b)
+        elif args.tile is not None:
+            rep = tf.tile(spec, tf.parse_tile(args.tile))
+        else:
+            na, nb = tf.parse_fuse(args.fuse)
+            rep = tf.fuse(spec, na, nb)
+    except ValueError as e:
+        p.error(f"transform mode: {e}")
+    doc = rep.doc()
+    diags = analysis.with_model(rep.diagnostics, spec.name)
+    rc = 0 if rep.code == "PL951" else 1
+    if args.check:
+        if rep.spec is None:
+            print(f"pluss transform: {spec.name}: check skipped "
+                  f"({rep.code}: no transformed spec)", file=sys.stderr)
+        else:
+            setup_platform()
+            ok, detail, cdiags = tf.check_transform(rep, cfg)
+            doc["check"] = detail
+            diags += analysis.with_model(cdiags, spec.name)
+            if detail.get("skipped"):
+                print(f"pluss transform: {rep.spec.name}: check "
+                      f"skipped (prediction refused: "
+                      f"{detail['codes']})", file=sys.stderr)
+            elif not ok:
+                rc = 1
+                print(f"pluss transform: {rep.spec.name}: CHECK FAILED "
+                      f"(PL954) {detail}", file=sys.stderr)
+            else:
+                kind = "bit-identical" if detail["mrc_exact"] \
+                    else f"l2={detail['mrc_l2_error']:.2e}"
+                print(f"pluss transform: {rep.spec.name}: verified "
+                      f"against engine.run (histograms bit-identical, "
+                      f"MRC {kind})", file=sys.stderr)
+    if args.register and rep.spec is not None:
+        import os
+
+        os.makedirs(args.registry_dir, exist_ok=True)
+        path = os.path.join(args.registry_dir, f"{rep.spec.name}.json")
+        with open(path, "w") as f:
+            f.write(spec_codec.dump_spec(rep.spec) + "\n")
+        print(f"pluss transform: registered {rep.spec.name} -> {path} "
+              f"(PLUSS_SPEC_DIR={args.registry_dir} serves it as a "
+              "registry model)", file=sys.stderr)
+    if args.sarif:
+        from pluss.analysis import sarif as sarif_mod
+
+        sarif_mod.write_sarif(args.sarif, diags)
+        print(f"pluss transform: SARIF log at {args.sarif}",
+              file=sys.stderr)
+    if args.json:
+        out.write(json_mod.dumps(doc, indent=1) + "\n")
+    else:
+        for d in diags:
+            out.write(d.format() + "\n")
+        tail = f" -> {rep.spec.name}" if rep.spec is not None else ""
+        out.write(f"pluss transform: {spec.name}: {rep.label()}"
+                  f"{tail} [{rep.code}]\n")
     return rc
 
 
@@ -686,8 +868,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("mode",
                    choices=("acc", "speed", "mrc", "trace", "sweep",
                             "sample", "lint", "analyze", "predict",
-                            "cotenancy", "tune", "stats", "serve",
-                            "import", "spec"))
+                            "cotenancy", "tune", "transform", "stats",
+                            "serve", "import", "spec"))
     p.add_argument("target", nargs="?", default=None,
                    help="stats mode: telemetry event stream (events.jsonl) "
                         "to aggregate; import mode: the .py (DSL) or .c "
@@ -695,7 +877,8 @@ def main(argv: list[str] | None = None) -> int:
                         "predict mode: the model to predict; cotenancy "
                         "mode: the co-scheduled workloads as "
                         "modelA+modelB[+...]; tune mode: the model to "
-                        "auto-tune")
+                        "auto-tune; transform mode: the model to "
+                        "transform")
     p.add_argument("arg2", nargs="?", default=None,
                    help="spec mode: the model to dump / the spec JSON "
                         "file to load")
@@ -891,6 +1074,23 @@ def main(argv: list[str] | None = None) -> int:
                    metavar="DIR",
                    help="import --register target directory (default "
                         ".pluss_registry)")
+    p.add_argument("--interchange", metavar="A,B", default=None,
+                   help="transform mode: interchange band levels A and "
+                        "B of nest 0 (legality proven from the "
+                        "dependence vectors first; e.g. 0,2)")
+    p.add_argument("--tile", metavar="L:S,...", default=None,
+                   help="transform mode: tile loop level L with size S "
+                        "(a comma list tiles a contiguous band; each "
+                        "size must divide its trip; e.g. 0:8,1:8,2:8)")
+    p.add_argument("--fuse", metavar="A+B", default=None,
+                   help="transform mode: fuse adjacent top-level nests "
+                        "A and B (e.g. 0+1)")
+    p.add_argument("--transforms", action="store_true",
+                   help="tune mode: extend the schedule search over the "
+                        "legal transform space (interchanges, "
+                        "hierarchy-laddered tilings, fusions) and "
+                        "report the best transformed schedule with its "
+                        "static MRC delta vs the untransformed winner")
     p.add_argument("--start-point", type=int, default=None,
                    help="resume sampling from this parallel-loop iteration "
                         "value (the reference's setStartPoint capability)")
@@ -913,16 +1113,17 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.target is not None and args.mode not in ("stats", "import",
                                                      "spec", "predict",
-                                                     "cotenancy", "tune"):
+                                                     "cotenancy", "tune",
+                                                     "transform"):
         # the optional positionals exist only for `stats <events.jsonl>`,
         # `import <file>`, `spec <dump|load> <what>`, `predict <model>`,
-        # `cotenancy <a+b>`, and `tune <model>`; anywhere else a stray
-        # argument must stay the usage error it always was (`pluss lint
-        # gemm` would otherwise silently lint the DEFAULT model and
-        # report it clean)
+        # `cotenancy <a+b>`, `tune <model>`, and `transform <model>`;
+        # anywhere else a stray argument must stay the usage error it
+        # always was (`pluss lint gemm` would otherwise silently lint
+        # the DEFAULT model and report it clean)
         p.error(f"unexpected argument {args.target!r} for mode "
                 f"{args.mode!r} (positional input is for stats/import/"
-                "spec/predict/cotenancy/tune modes only; use "
+                "spec/predict/cotenancy/tune/transform modes only; use "
                 "--model/--file)")
     if args.arg2 is not None and args.mode != "spec":
         p.error(f"unexpected argument {args.arg2!r} for mode "
@@ -1007,6 +1208,12 @@ def main(argv: list[str] | None = None) -> int:
         # tune.py): the search is host math with zero dispatches —
         # --check alone boots a device for the winner's engine cross-run
         return _tune_main(args, p, sys.stdout, setup_platform)
+
+    if args.mode == "transform":
+        # loop-transformation legality prover + spec-to-spec transformer
+        # (pluss/analysis/transform.py): host math end to end — --check
+        # alone boots a device to run the TRANSFORMED spec once
+        return _transform_main(args, p, sys.stdout, setup_platform)
 
     setup_platform()
 
@@ -1159,6 +1366,12 @@ def main(argv: list[str] | None = None) -> int:
         tuned = sweep_mod.tuned_block(spec, pts)
         if tuned:
             out.write(tuned + "\n")
+        # transform-space search over the same axes: the best proven-
+        # legal (transform, schedule) pair and its static MRC delta
+        # (pluss/analysis/transform.py)
+        trans = sweep_mod.transform_block(spec, pts)
+        if trans:
+            out.write(trans + "\n")
     else:  # trace: dynamic replay (BASELINE config 5; bypasses CRI like the
         # reference's pluss_access path — see pluss/trace.py)
         if not args.file:

@@ -448,6 +448,38 @@ def tuned_block(spec: LoopNestSpec,
     return "\n".join(lines)
 
 
+def transform_block(spec: LoopNestSpec,
+                    points: Iterable[SweepPoint]) -> str:
+    """Transform-space block for the sweep report (r18): one
+    :func:`pluss.analysis.transform.search_transforms` pass over exactly
+    the swept (threads, chunk) axes, reporting the best proven-legal
+    (transform, schedule) pair and its static MRC delta against the
+    untransformed winner — so the sweep table shows what a code-shape
+    change would buy on top of the schedule it already prices.  A tune
+    refusal prints the typed verdict instead of numbers."""
+    from pluss.analysis import transform as tf
+    from pluss.analysis import tune as tune_mod
+
+    points = list(points)
+    if not points:
+        return ""
+    threads = tuple(sorted({p.cfg.thread_num for p in points}))
+    chunks = tuple(sorted({p.cfg.chunk_size for p in points}))
+    rep = tf.search_transforms(
+        spec, base_cfg=points[0].cfg,
+        candidates=tune_mod.space(threads, chunks))
+    lines = [f"transform search (PL95x, {rep.target_kb} KB LLC):"]
+    for d in rep.diagnostics:
+        lines.append(f"  [{d.code}] {d.message}")
+    if rep.best is not None:
+        lines.append(
+            f"  best: {rep.best.transform.label()} + "
+            f"{rep.best.tune.winner.candidate.label()} predicts "
+            f"{rep.best.score():.4g} (delta {rep.delta:+.4g} vs "
+            "untransformed winner)")
+    return "\n".join(lines)
+
+
 def carried_levels(spec: LoopNestSpec) -> str:
     """The static analyzer's PL303 carried-level classifications as a
     compact report block (ROADMAP PR-1 follow-up): one line per annotated

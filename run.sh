@@ -70,6 +70,21 @@ assert not errs, errs; print('tune SARIF smoke: valid,', \
 rm -f "$PLUSS_TUNE_SARIF"
 JAX_PLATFORMS=cpu python -m pluss.cli tune --all --n 16 --check --cpu 1>&2
 
+# loop-transformation gate (tier-1, r18): the legality prover + spec-to-
+# spec transformer (pluss/analysis/transform.py).  The proven-legal gemm
+# interchange must run through the live engine bit-identically to its
+# own static MRC prediction (--check; any PL954 disagreement fails the
+# driver), and the PL95x SARIF export must survive the structural
+# validator.
+PLUSS_TF_SARIF=$(mktemp /tmp/pluss_transform_XXXX.sarif)
+JAX_PLATFORMS=cpu python -m pluss.cli transform gemm --interchange 0,2 \
+  --n 16 --check --cpu --sarif "$PLUSS_TF_SARIF" 1>&2
+python -c "import json, sys; from pluss.analysis import sarif; \
+doc = json.load(open(sys.argv[1])); errs = sarif.validate(doc); \
+assert not errs, errs; print('transform SARIF smoke: valid,', \
+    len(doc['runs'][0]['results']), 'result(s)')" "$PLUSS_TF_SARIF" 1>&2
+rm -f "$PLUSS_TF_SARIF"
+
 # frontend import smoke (tier-1): the checked-in gemm.ppcg_omp-shaped C
 # source → tokenizer → recursive-descent parse → lower → share-span
 # derivation → PR-1 analyzer gate → engine run, with --check-model

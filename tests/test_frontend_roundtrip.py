@@ -53,3 +53,40 @@ def test_roundtrip_preserves_spans_without_auto_derivation():
     spec = REGISTRY["conv2d"]()
     (reparsed,) = frontend.from_py(frontend.emit_dsl(spec))
     assert spec_codec.specs_equal(reparsed, spec)
+
+
+# --- transformed specs (r18): tiling introduces synthetic non-unit-stride
+# tile loops; the emitter must express them via the plain `step=` sugar,
+# never the loop_raw escape hatch -------------------------------------------
+
+
+@pytest.mark.parametrize("name,tiles", [
+    ("gemm", [(0, 8), (1, 8), (2, 8)]),   # full-band (parallel loop strided)
+    ("gemm", [(2, 8)]),                   # innermost strip-mine only
+    ("syrk", [(0, 8), (1, 8)]),           # write-carrying band
+    ("stencil3d", [(1, 5), (2, 5)]),      # nonzero-start inner loops
+])
+def test_tiled_spec_roundtrips_through_dsl(name, tiles):
+    from pluss.analysis import transform as tf
+
+    spec = REGISTRY[name](32)
+    rep = tf.tile(spec, tiles)
+    assert rep.code == "PL951", rep.diagnostics
+    src = frontend.emit_dsl(rep.spec)
+    assert "loop_raw" not in src, "tile loops must emit as step= sugar"
+    (reparsed,) = frontend.from_py(src, filename=f"<emit:{rep.spec.name}>")
+    assert spec_codec.specs_equal(reparsed, rep.spec), (
+        f"{rep.spec.name}: emit_dsl -> from_py is not the identity")
+
+
+@pytest.mark.parametrize("name,apply", [
+    ("gemm", lambda tf, s: tf.interchange(s, 0, 2)),
+    ("2mm", lambda tf, s: tf.fuse(s, 0, 1)),   # fusion renames colliding refs
+])
+def test_other_transforms_roundtrip_through_dsl(name, apply):
+    from pluss.analysis import transform as tf
+
+    rep = apply(tf, REGISTRY[name](32))
+    assert rep.code == "PL951", rep.diagnostics
+    (reparsed,) = frontend.from_py(frontend.emit_dsl(rep.spec))
+    assert spec_codec.specs_equal(reparsed, rep.spec)

@@ -1,0 +1,508 @@
+"""r18: the loop-transformation legality prover, the spec-to-spec
+transformer, and the transform-space tune search (`pluss transform`,
+`pluss tune --transforms`, PL95x).
+
+The load-bearing claims pinned here:
+
+- the dependence-vector core is EXACT: its edge set for a nest equals
+  the brute-force enumeration of every conflicting instance pair's
+  direction pattern at small n;
+- every transform the prover marks PL951-legal, applied, preserves the
+  execution order of EVERY conflicting access pair (brute-force
+  iteration-space oracle over the provenance instance mapping);
+- every PL951 transformed spec run through the live engine matches its
+  own static MRC prediction bit-identically — transformed specs ride
+  the whole existing proof chain unchanged;
+- every PL952 carries a CONCRETE violating pair the oracle confirms: a
+  real same-address conflict, ordered src-before-dst originally, whose
+  order the transform would reverse;
+- nests outside the vector contract (triangular, quad) refuse with a
+  typed PL953 cause chain — never a silent guess;
+- `tune --transforms` finds a tiled gemm schedule with a strictly
+  better predicted LLC miss ratio than the untransformed PL901 winner,
+  with zero device dispatches during the search, and the winner's
+  engine cross-check is bit-identical;
+- the README documents the PL95x rows and legality rules this code
+  actually ships (the code-table sync test covers the new family).
+"""
+
+import itertools
+import json
+
+import pytest
+
+import tests.conftest  # noqa: F401  (CPU platform + x64)
+from pluss import cli, engine, frontend, spec_codec
+from pluss.analysis import depvec
+from pluss.analysis import transform as tf
+from pluss.analysis import tune as tune_mod
+from pluss.analysis.diagnostics import CODES
+from pluss.config import SamplerConfig
+from pluss.model import hierarchy as hier_mod
+from pluss.models import REGISTRY
+from pluss.spec import Ref
+
+BASE = SamplerConfig(thread_num=4, chunk_size=4)
+
+
+# ---------------------------------------------------------------------------
+# the brute-force iteration-space oracle
+
+
+def enumerate_accesses(spec):
+    """Serial access stream: (nest, name, values, array, addr, write)."""
+    out = []
+
+    def walk(body, values, ni):
+        for x in body:
+            if isinstance(x, Ref):
+                addr = x.addr_base + sum(c * values[d]
+                                         for d, c in x.addr_terms)
+                out.append((ni, x.name, tuple(values), x.array, addr,
+                            x.is_write))
+            else:
+                for i in range(x.trip):
+                    walk(x.body, values + [x.start + x.step * i], ni)
+
+    for ni, nest in enumerate(spec.nests):
+        walk([nest], [], ni)
+    return out
+
+
+def order_violations(spec, rep):
+    """All conflicting original pairs whose execution order the
+    transformed spec reverses (empty = order-preserving).  Also asserts
+    the provenance mapping is a bijection onto the original stream."""
+    orig = enumerate_accesses(spec)
+    trans = enumerate_accesses(rep.spec)
+    mapper = tf.instance_mapper(rep.provenance)
+    pos = {}
+    for i, (ni, nm, vals, *_rest) in enumerate(orig):
+        pos[(ni, nm, vals)] = i
+    assert len(pos) == len(orig), "original instances are not unique"
+    perm = [pos[mapper(ni, nm, vals)]
+            for (ni, nm, vals, *_rest) in trans]
+    assert sorted(perm) == list(range(len(orig))), (
+        f"{rep.spec.name}: instance mapping is not a bijection "
+        f"({len(perm)} mapped vs {len(orig)} original)")
+    newpos = [0] * len(orig)
+    for t, o in enumerate(perm):
+        newpos[o] = t
+    bygroup = {}
+    for i, (_ni, _nm, _vals, arr, addr, w) in enumerate(orig):
+        bygroup.setdefault((arr, addr), []).append((i, w))
+    bad = []
+    for g in bygroup.values():
+        for (i, wi), (j, wj) in itertools.combinations(g, 2):
+            if (wi or wj) and (newpos[i] < newpos[j]) != (i < j):
+                bad.append((orig[i][:3], orig[j][:3]))
+    return bad
+
+
+LEGAL_CASES = [
+    ("gemm", lambda s: tf.interchange(s, 0, 2)),
+    ("gemm", lambda s: tf.interchange(s, 1, 2)),
+    ("gemm", lambda s: tf.tile(s, [(0, 3), (1, 3), (2, 3)])),
+    ("gemm", lambda s: tf.tile(s, [(2, 3)])),        # strip-mine only
+    ("syrk", lambda s: tf.interchange(s, 0, 1)),
+    ("syrk", lambda s: tf.tile(s, [(0, 3), (1, 3)])),
+    ("2mm", lambda s: tf.fuse(s, 0, 1)),
+    ("3mm", lambda s: tf.fuse(s, 0, 1)),
+    ("mvt", lambda s: tf.fuse(s, 0, 1)),
+    ("atax", lambda s: tf.fuse(s, 0, 1)),
+    ("stencil3d", lambda s: tf.interchange(s, 1, 2)),
+    ("heat3d", lambda s: tf.interchange(s, 1, 2)),
+    ("floyd_warshall", lambda s: tf.interchange(s, 1, 2)),
+    ("fdtd2d", lambda s: tf.fuse(s, 0, 1)),
+]
+
+
+@pytest.mark.parametrize("name,apply", LEGAL_CASES)
+def test_legal_transform_preserves_dependence_order(name, apply):
+    """Every PL951 verdict, checked exhaustively: the transformed
+    iteration space executes every conflicting access pair in the
+    original order."""
+    spec = REGISTRY[name](6)
+    rep = apply(spec)
+    assert rep.code == "PL951", (name, rep.code, rep.diagnostics)
+    assert rep.provenance is not None
+    bad = order_violations(spec, rep)
+    assert not bad, (
+        f"{rep.spec.name}: {len(bad)} order violation(s), e.g. {bad[:3]}")
+
+
+def test_depvec_edges_match_bruteforce_enumeration():
+    """The vector core is exact: for each same-nest write-involving site
+    pair, the prover's direction-pattern set equals the brute-force set
+    realized by actual conflicting instance pairs."""
+    for name in ("gemm", "jacobi2d", "seidel2d", "mvt"):
+        spec = REGISTRY[name](5)
+        acc = enumerate_accesses(spec)
+        truth = set()
+        for (n1, m1, v1, a1, ad1, w1), (n2, m2, v2, a2, ad2, w2) \
+                in itertools.combinations(acc, 2):
+            if n1 != n2 or a1 != a2 or ad1 != ad2 or not (w1 or w2):
+                continue
+            c = min(len(v1), len(v2))
+            sigma = tuple((v2[k] > v1[k]) - (v2[k] < v1[k])
+                          for k in range(c))
+            if m1 == m2 and all(s == 0 for s in sigma):
+                continue  # same instance
+            truth.add((m1, m2, sigma))
+        vecs = depvec.spec_vectors(spec)
+        got = set()
+        for nv in vecs:
+            assert nv.refused is None, (name, nv.refused)
+            for e in nv.edges:
+                got.add((e.src.ref.name, e.dst.ref.name, e.sigma))
+        # normalize truth the way the prover does: source is the
+        # program-earlier access, vector lex-nonnegative
+        norm = set()
+        for m1, m2, sigma in truth:
+            lex = next((1 if s > 0 else -1 for s in sigma if s), 0)
+            if lex < 0:
+                norm.add((m2, m1, tuple(-s for s in sigma)))
+            else:
+                norm.add((m1, m2, sigma))
+        assert got == norm, (name, got ^ norm)
+
+
+# ---------------------------------------------------------------------------
+# engine bit-identity of transformed specs (>= 6 families x 3 kinds)
+
+
+ENGINE_CASES = [
+    ("gemm", lambda s: tf.interchange(s, 0, 2)),
+    ("gemm", lambda s: tf.tile(s, [(0, 4), (1, 4), (2, 4)])),
+    ("syrk", lambda s: tf.interchange(s, 0, 1)),
+    ("syrk", lambda s: tf.tile(s, [(0, 4), (1, 4)])),
+    ("2mm", lambda s: tf.fuse(s, 0, 1)),
+    ("3mm", lambda s: tf.fuse(s, 0, 1)),
+    ("mvt", lambda s: tf.fuse(s, 0, 1)),
+    ("stencil3d", lambda s: tf.interchange(s, 1, 2)),
+    ("heat3d", lambda s: tf.interchange(s, 1, 2)),
+    ("atax", lambda s: tf.fuse(s, 0, 1)),
+]
+
+
+@pytest.mark.parametrize("name,apply", ENGINE_CASES)
+def test_transformed_spec_engine_check_bit_identical(name, apply):
+    """A PL951 spec is an ordinary spec: the live engine run matches the
+    static MRC prediction of the TRANSFORMED nest bit-identically."""
+    rep = apply(REGISTRY[name](8))
+    assert rep.code == "PL951", (name, rep.code, rep.diagnostics)
+    ok, detail, diags = tf.check_transform(rep, BASE)
+    assert not detail.get("skipped"), (name, detail)
+    assert ok, (name, detail, [d.message for d in diags])
+    assert detail["histogram_identical"], (name, detail)
+
+
+# ---------------------------------------------------------------------------
+# PL952: the violating pair is oracle-real
+
+
+def _site_of(spec, ni, name):
+    (site,) = [s for s in depvec.ref_sites(spec)
+               if s.nest == ni and s.ref.name == name]
+    return site
+
+
+def _addr_at(site, iv):
+    values = [l.start + l.step * i for l, i in zip(site.chain, iv)]
+    return site.ref.addr_base + sum(c * values[d]
+                                    for d, c in site.ref.addr_terms)
+
+
+ILLEGAL_CASES = [
+    ("seidel2d", lambda s: tf.interchange(s, 0, 1)),
+    ("seidel2d", lambda s: tf.interchange(s, 0, 2)),
+    ("floyd_warshall", lambda s: tf.interchange(s, 0, 1)),
+    ("floyd_warshall", lambda s: tf.interchange(s, 0, 2)),
+    ("jacobi2d", lambda s: tf.fuse(s, 0, 1)),
+    ("3mm", lambda s: tf.fuse(s, 1, 2)),
+    ("gemver", lambda s: tf.fuse(s, 0, 1)),
+]
+
+
+@pytest.mark.parametrize("name,apply", ILLEGAL_CASES)
+def test_pl952_violating_pair_is_oracle_confirmed(name, apply):
+    """Every proven-illegal verdict carries a concrete witness pair the
+    brute-force semantics confirm: a real same-address conflict, with at
+    least one write, src executing before dst, whose order the transform
+    would reverse."""
+    spec = REGISTRY[name](8)
+    rep = apply(spec)
+    assert rep.code == "PL952", (name, rep.code, rep.diagnostics)
+    v = rep.violation
+    assert v is not None
+    src_iv, dst_iv = tuple(v["src_iv"]), tuple(v["dst_iv"])
+    if rep.kind == "fuse":
+        na, nb = rep.params["a"], rep.params["b"]
+        src = _site_of(spec, na, v["src"])
+        dst = _site_of(spec, nb, v["dst"])
+    else:
+        ni = rep.params["nest"]
+        src = _site_of(spec, ni, v["src"])
+        dst = _site_of(spec, ni, v["dst"])
+    # in-range witness instances on a REAL conflict
+    for site, iv in ((src, src_iv), (dst, dst_iv)):
+        assert len(iv) == len(site.chain)
+        assert all(0 <= i < l.trip for i, l in zip(iv, site.chain)), (
+            name, iv)
+    assert src.ref.array == dst.ref.array
+    assert src.ref.is_write or dst.ref.is_write
+    assert _addr_at(src, src_iv) == _addr_at(dst, dst_iv), (
+        name, "witness pair does not collide")
+    if rep.kind == "fuse":
+        # src's nest runs first today; fused, the dst instance at the
+        # strictly smaller outer index would run before its source
+        assert dst_iv[0] < src_iv[0], (name, src_iv, dst_iv)
+    else:
+        c = len(v["vector"])
+        assert src_iv[:c] <= dst_iv[:c], "src must execute first"
+        a, b = rep.params["a"], rep.params["b"]
+        ps, pd = list(src_iv[:c]), list(dst_iv[:c])
+        ps[a], ps[b] = ps[b], ps[a]
+        pd[a], pd[b] = pd[b], pd[a]
+        assert pd < ps, (
+            name, "swap does not reverse the witness pair's order")
+
+
+# ---------------------------------------------------------------------------
+# PL953: typed refusals, never silent guesses
+
+
+@pytest.mark.parametrize("name", ["trmm", "syrk_tri", "cholesky",
+                                  "ludcmp", "covariance"])
+def test_triangular_and_quad_nests_refuse_typed(name):
+    spec = REGISTRY[name](8)
+    for rep in (tf.interchange(spec, 0, 1), tf.tile(spec, [(0, 2)])):
+        assert rep.code == "PL953", (name, rep.code)
+        assert rep.spec is None
+        (d,) = [g for g in rep.diagnostics if g.code == "PL953"]
+        assert "contract" in d.message or "refused" in d.message
+
+
+def test_budget_exhaustion_refuses_typed(monkeypatch):
+    monkeypatch.setenv("PLUSS_DEPVEC_BUDGET", "1")
+    rep = tf.interchange(REGISTRY["gemm"](8), 0, 2)
+    assert rep.code == "PL953"
+    assert "budget" in rep.diagnostics[0].message.lower()
+
+
+def test_malformed_cli_params_raise():
+    with pytest.raises(ValueError):
+        tf.parse_interchange("0")
+    with pytest.raises(ValueError):
+        tf.parse_tile("0-8")
+    with pytest.raises(ValueError):
+        tf.parse_fuse("0")
+
+
+# ---------------------------------------------------------------------------
+# the transform-space search (tune --transforms)
+
+
+def test_search_transforms_beats_untransformed_gemm():
+    """The r18 acceptance pin: at a 1 KB LLC the search proves a tiled
+    gemm schedule strictly better than the untransformed PL901 winner,
+    with ZERO device dispatches, and the engine confirms the winner's
+    prediction bit-identically."""
+    spec = REGISTRY["gemm"](64)
+    hier = hier_mod.HierarchyConfig(levels_kb=(1,), assoc=0, policy="lru")
+    cands = tune_mod.space((1, 2, 4), (1, 4))
+    d0 = engine.DEVICE_DISPATCHES
+    rep = tf.search_transforms(spec, candidates=cands, hier=hier)
+    assert engine.DEVICE_DISPATCHES == d0, "search touched the device"
+    assert rep.best is not None, [d.message for d in rep.diagnostics]
+    assert rep.best.transform.kind == "tile"
+    base_score = rep.base.winner.score
+    assert rep.best.score() < base_score - tune_mod.TIE_EPS
+    assert rep.delta == rep.best.score() - base_score
+    ok, detail, _ = tune_mod.check_winner(rep.best.transform.spec,
+                                          rep.best.tune)
+    assert ok, detail
+    assert detail["histogram_identical"] and detail["mrc_exact"], detail
+
+
+def test_search_transforms_doc_shape():
+    spec = REGISTRY["gemm"](16)
+    rep = tf.search_transforms(spec, candidates=tune_mod.space((1, 2),
+                                                               (1,)))
+    doc = rep.doc()
+    assert doc["model"] == "gemm16"
+    assert doc["base"]["verdict"] in ("PL901", "PL902")
+    assert doc["transforms"], "transform space must not be empty"
+    for e in doc["transforms"]:
+        assert e["verdict"] in ("PL951", "PL952", "PL953")
+    json.dumps(doc)  # the whole report must be JSON-serializable
+
+
+def test_tile_ladder_sizes_divide_and_fit():
+    spec = REGISTRY["gemm"](64)
+    hier = hier_mod.HierarchyConfig(levels_kb=(1, 32), assoc=0,
+                                    policy="lru")
+    trips = [64, 64, 64]
+    sizes = tf.tile_ladder(spec, trips, BASE, hier)
+    assert sizes, "ladder empty for a hierarchy that fits tiles"
+    for s in sizes:
+        assert 2 <= s < 64 and 64 % s == 0
+
+
+# ---------------------------------------------------------------------------
+# transformed specs are ordinary specs (registerable, emittable)
+
+
+def test_transformed_spec_registers_and_reloads(tmp_path):
+    rep = tf.tile(REGISTRY["gemm"](32), [(0, 8), (1, 8), (2, 8)])
+    path = tmp_path / f"{rep.spec.name}.json"
+    path.write_text(spec_codec.dump_spec(rep.spec) + "\n")
+    reloaded = spec_codec.load_spec_file(str(path))
+    assert spec_codec.specs_equal(reloaded, rep.spec)
+
+
+def test_transform_share_spans_rederived_not_stale():
+    """The transformer re-derives share_span through the frontend
+    pipeline: a tiled gemm's spans must equal the derivation on the
+    tiled nest itself (derive_spans is a fixed point), never the
+    original nest's copied values."""
+    from pluss.frontend.lower import derive_spans
+
+    rep = tf.tile(REGISTRY["gemm"](32), [(0, 4), (1, 4), (2, 4)])
+    assert rep.code == "PL951"
+    assert spec_codec.specs_equal(derive_spans(rep.spec), rep.spec)
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+
+
+def test_cli_transform_legal(capsys):
+    rc = cli.main(["transform", "gemm", "--interchange", "0,2",
+                   "--n", "16"])
+    outerr = capsys.readouterr()
+    assert rc == 0
+    assert "PL951" in outerr.out
+    assert "gemm16_ic02" in outerr.out
+
+
+def test_cli_transform_illegal_exits_nonzero(capsys):
+    rc = cli.main(["transform", "seidel2d", "--interchange", "0,1",
+                   "--n", "8"])
+    outerr = capsys.readouterr()
+    assert rc == 1
+    assert "PL952" in outerr.out
+    assert "violating pair" in outerr.out
+
+
+def test_cli_transform_refusal_exits_nonzero(capsys):
+    rc = cli.main(["transform", "trmm", "--interchange", "0,1",
+                   "--n", "8"])
+    outerr = capsys.readouterr()
+    assert rc == 1
+    assert "PL953" in outerr.out
+
+
+def test_cli_transform_json_carries_spec_and_edges(capsys):
+    rc = cli.main(["transform", "gemm", "--tile", "0:4,1:4,2:4",
+                   "--n", "16", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["verdict"] == "PL951"
+    assert doc["kind"] == "tile"
+    assert doc["edges"], "witness vectors must ride the JSON doc"
+    assert doc["spec"]["name"] == "gemm16_tile0x4_1x4_2x4"
+
+
+def test_cli_transform_check_engine(capsys):
+    rc = cli.main(["transform", "gemm", "--interchange", "0,2",
+                   "--n", "16", "--check", "--cpu"])
+    outerr = capsys.readouterr()
+    assert rc == 0
+    assert "verified against engine.run" in outerr.err
+    assert "bit-identical" in outerr.err
+
+
+def test_cli_transform_sarif(tmp_path):
+    from pluss.analysis import sarif
+
+    log = tmp_path / "transform.sarif"
+    rc = cli.main(["transform", "gemm", "--interchange", "0,2",
+                   "--n", "16", "--sarif", str(log)])
+    assert rc == 0
+    doc = json.loads(log.read_text())
+    assert sarif.validate(doc) == []
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert "PL951" in rules
+
+
+def test_cli_transform_register(tmp_path, capsys):
+    rc = cli.main(["transform", "gemm", "--tile", "0:8,1:8,2:8",
+                   "--n", "32", "--register", "--registry-dir",
+                   str(tmp_path)])
+    outerr = capsys.readouterr()
+    assert rc == 0
+    assert "registered gemm32_tile0x8_1x8_2x8" in outerr.err
+    reloaded = spec_codec.load_spec_file(
+        str(tmp_path / "gemm32_tile0x8_1x8_2x8.json"))
+    assert reloaded.name == "gemm32_tile0x8_1x8_2x8"
+
+
+def test_cli_transform_wants_exactly_one_flag():
+    with pytest.raises(SystemExit):
+        cli.main(["transform", "gemm", "--n", "16"])
+    with pytest.raises(SystemExit):
+        cli.main(["transform", "gemm", "--interchange", "0,1",
+                  "--tile", "0:4", "--n", "16"])
+    with pytest.raises(SystemExit):
+        cli.main(["transform", "nosuch", "--interchange", "0,1"])
+
+
+def test_cli_tune_transforms(capsys):
+    rc = cli.main(["tune", "gemm", "--transforms", "--n", "16",
+                   "--sweep-threads", "1,2", "--sweep-chunks", "1"])
+    outerr = capsys.readouterr()
+    assert rc == 0
+    assert "transform space" in outerr.out
+    with pytest.raises(SystemExit):
+        cli.main(["tune", "--all", "--transforms", "--n", "16"])
+
+
+def test_cli_analyze_surfaces_depvectors(capsys):
+    rc = cli.main(["analyze", "--model", "gemm", "--n", "16", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    dv = doc["depvectors"]["gemm16"]
+    assert dv["edges"] > 0
+    edge = dv["nests"][0]["edges"][0]
+    for key in ("src", "dst", "array", "kind", "vector", "distance",
+                "src_iv", "dst_iv"):
+        assert key in edge
+
+
+def test_cli_analyze_race_findings_carry_vectors(capsys):
+    rc = cli.main(["analyze", "--model", "atax", "--n", "16"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    race_lines = [l for l in out.splitlines()
+                  if "PL301" in l or "PL302" in l]
+    assert race_lines
+    assert all("dep vectors:" in l for l in race_lines), race_lines
+
+
+# ---------------------------------------------------------------------------
+# diagnostics registry
+
+
+def test_pl95x_codes_registered():
+    for code in ("PL951", "PL952", "PL953", "PL954"):
+        family, _ = CODES[code]
+        assert family == "transform"
+
+
+def test_emitted_transformed_dsl_reimports():
+    """The emit_dsl round-trip of a tiled spec rides the real import
+    path end to end (frontend.from_py), not just the codec."""
+    rep = tf.tile(REGISTRY["gemm"](32), [(0, 8), (1, 8), (2, 8)])
+    (re_,) = frontend.from_py(frontend.emit_dsl(rep.spec))
+    assert spec_codec.specs_equal(re_, rep.spec)

@@ -518,7 +518,9 @@ def test_readme_documents_tune_and_placement():
     rows = dict(re.findall(r"^\| (PL9\d{2}) \| (\w+) \|", readme,
                            flags=re.M))
     assert rows == {"PL901": "info", "PL902": "info",
-                    "PL903": "warning", "PL904": "error"}
+                    "PL903": "warning", "PL904": "error",
+                    "PL951": "info", "PL952": "error",
+                    "PL953": "warning", "PL954": "error"}
     assert "## Schedule tuning & placement: `pluss tune`" in readme
     assert re.search(r"^\| `PLUSS_SERVE_PLACEMENT` \| `off` \|", readme,
                      flags=re.M), "placement knob row with its default"
