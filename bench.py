@@ -629,6 +629,90 @@ def bench_trace(n_refs: int) -> None:
          shrunk=bool(n_run != n_refs), **compile_stamp(c_init), **obs_extra)
 
 
+def bench_pallas(n_refs: int) -> None:
+    """Fused-kernel A/B headline (r19): the same streamed replay over the
+    same trace prefix with the fused Pallas pipeline (event histogram +
+    d24v decode) forced ON vs forced OFF — ``vs_baseline`` IS the fused
+    advantage (>1: the fused kernels win).  Skipped with a log line when
+    either kernel fails its compile probe on this backend: production
+    would be running the loud XLA fallback, and the A/B would measure
+    XLA vs XLA."""
+    import numpy as np
+
+    from pluss import trace
+    from pluss.ops import pallas_decode, pallas_events
+    from pluss.utils import envknob
+
+    run_refs = min(n_refs, 64 * (1 << 20))
+    path = ensure_trace(n_refs)
+    saved = {k: os.environ.get(k)
+             for k in ("PLUSS_PALLAS_EVENTS", "PLUSS_PALLAS_DECODE")}
+
+    def set_flag(flag: str | None) -> None:
+        for k in saved:
+            if flag is None:
+                if saved[k] is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = saved[k]
+            else:
+                os.environ[k] = flag
+        envknob._parse_bool.cache_clear()
+
+    try:
+        set_flag("1")
+        pallas_events.reset_probe()
+        pallas_decode.reset_probe()
+        if not (pallas_events.probe_ok() and pallas_decode.probe_ok()):
+            log("bench: pallas A/B skipped — a fused kernel failed its "
+                "compile probe (XLA fallback is the production path)")
+            return
+
+        def timed(label: str):
+            trace.replay_file(path, limit_refs=run_refs,
+                              wire="d24v")      # warm: compile + run
+            t0 = time.perf_counter()
+            r = trace.replay_file(path, limit_refs=run_refs, wire="d24v")
+            dt = time.perf_counter() - t0
+            log(f"bench: pallas A/B {label}: "
+                f"{r.total_count / dt:.3e} refs/s")
+            return r, dt
+
+        fused, fused_s = timed("fused")
+        set_flag("0")
+        xla, xla_s = timed("xla")
+    finally:
+        set_flag(None)
+    emit(f"trace{run_refs}_pallas_refs_per_sec", fused.total_count,
+         fused_s, xla_s, path="trace_stream_fused",
+         degradations=tuple(fused.degradations),
+         bit_identical=bool(np.array_equal(fused.hist, xla.hist)))
+
+
+def bench_autotune() -> None:
+    """Autotune calibration-cost headline (r19): wall seconds one FORCED
+    geometry calibration costs this runtime, with the persisted winner on
+    the line.  The sidecar lands beside the .bench AOT sidecars, so every
+    later bench/driver run on this box consults it for free."""
+    from pluss import autotune
+
+    t0 = time.perf_counter()
+    doc = autotune.calibrate(n_refs=1 << 20, force=True)
+    cal_s = time.perf_counter() - t0
+    log(f"bench: autotune calibrated in {cal_s:.1f}s -> "
+        f"{doc['geometry']} ({doc['refs_per_sec']:.3e} refs/s)")
+    print(json.dumps({
+        "metric": "autotune_calibration_s",
+        "value": round_keep(cal_s, 3),
+        "unit": "s",
+        "vs_baseline": None,
+        "path": "autotune",
+        "degradations": [],
+        "geometry": doc["geometry"],
+        "winner_refs_per_sec": round_keep(doc["refs_per_sec"], 1),
+    }), flush=True)
+
+
 def bench_multichip(trace_refs: int) -> None:
     """Multi-chip scale-out headlines (round r09 on): refs/s of the
     work-stealing sharded dispatch vs the single-device engine on the
@@ -1404,6 +1488,18 @@ def main() -> int:
                                        1_000_000_000)))
             except Exception as e:
                 log(f"bench: multichip metric failed: {e}")
+        # r19 headlines on the CPU fallback too (interpreter-mode Pallas;
+        # a small trace keeps the A/B inside the fallback budget)
+        if budget_ok("pallas_ab", 120):
+            try:
+                bench_pallas(1 << 22)
+            except Exception as e:
+                log(f"bench: pallas A/B metric failed: {e}")
+        if budget_ok("autotune", 180):
+            try:
+                bench_autotune()
+            except Exception as e:
+                log(f"bench: autotune metric failed: {e}")
         return 0
 
     # headline FIRST (round 3's record has rc=124 with this metric still
@@ -1500,6 +1596,21 @@ def main() -> int:
             bench_trace(trace_refs)
         except Exception as e:
             log(f"bench: trace metric failed: {e}")
+
+    # fused-kernel A/B + autotune calibration cost (round r19 on): the
+    # Pallas pipeline's measured advantage over the XLA path on this
+    # backend, and what one forced geometry calibration costs (its winner
+    # persists beside the .bench AOT sidecars for every later run)
+    if budget_ok("pallas_ab", 180):
+        try:
+            bench_pallas(trace_refs)
+        except Exception as e:
+            log(f"bench: pallas A/B metric failed: {e}")
+    if budget_ok("autotune", 240):
+        try:
+            bench_autotune()
+        except Exception as e:
+            log(f"bench: autotune metric failed: {e}")
 
     # multi-chip scale-out headlines (round r09 on): work-stealing sharded
     # dispatch vs single device on the quad nests + the streamed trace,

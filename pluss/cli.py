@@ -584,6 +584,31 @@ def _tune_transforms(args, out, setup_platform, cands, hier) -> int:
     return rc
 
 
+def _autotune_main(args, p, out, setup_platform) -> int:
+    """``pluss autotune [--force] [--dry-run] [--refs N]`` — calibrate
+    and persist the streamed-replay batch geometry for THIS runtime
+    (:mod:`pluss.autotune`), or with ``--dry-run`` just validate the
+    persisted sidecar.  The winner feeds ``replay_file``'s defaults and
+    the fused-kernel resolution on every later run (witnessed by the
+    ``autotune.hit`` counter — zero re-calibration)."""
+    from pluss import autotune
+
+    if args.force and args.dry_run:
+        p.error("autotune mode: --force and --dry-run are exclusive "
+                "(--dry-run never calibrates)")
+    if args.dry_run:
+        # pure sidecar validation: no device, no platform setup
+        return autotune.dry_run(out)
+    setup_platform()
+    kw = {} if args.refs is None else {"n_refs": args.refs}
+    doc = autotune.calibrate(force=args.force, out=sys.stderr, **kw)
+    geo = doc["geometry"]
+    out.write("pluss autotune: winner "
+              + "  ".join(f"{k}={geo[k]}" for k in sorted(geo))
+              + f"  ({doc.get('refs_per_sec', 0):.0f} refs/s)\n")
+    return 0
+
+
 def _transform_main(args, p, out, setup_platform) -> int:
     """``pluss transform <model> (--interchange A,B | --tile L:S,... |
     --fuse A+B) [--json|--sarif|--check|--register]`` — the proof-
@@ -869,7 +894,7 @@ def main(argv: list[str] | None = None) -> int:
                    choices=("acc", "speed", "mrc", "trace", "sweep",
                             "sample", "lint", "analyze", "predict",
                             "cotenancy", "tune", "transform", "stats",
-                            "serve", "import", "spec"))
+                            "serve", "import", "spec", "autotune"))
     p.add_argument("target", nargs="?", default=None,
                    help="stats mode: telemetry event stream (events.jsonl) "
                         "to aggregate; import mode: the .py (DSL) or .c "
@@ -1091,6 +1116,19 @@ def main(argv: list[str] | None = None) -> int:
                         "hierarchy-laddered tilings, fusions) and "
                         "report the best transformed schedule with its "
                         "static MRC delta vs the untransformed winner")
+    p.add_argument("--force", action="store_true",
+                   help="autotune mode: recalibrate even when a valid "
+                        "geometry sidecar is already persisted for this "
+                        "runtime")
+    p.add_argument("--dry-run", action="store_true",
+                   help="autotune mode: validate the persisted sidecar "
+                        "and print the tuned geometry WITHOUT running "
+                        "any calibration (exit 1 only when a sidecar "
+                        "exists but fails validation)")
+    p.add_argument("--refs", type=int, default=None,
+                   help="autotune mode: calibration replay length in "
+                        "references (default 2^20); smaller is faster "
+                        "but noisier")
     p.add_argument("--start-point", type=int, default=None,
                    help="resume sampling from this parallel-loop iteration "
                         "value (the reference's setStartPoint capability)")
@@ -1214,6 +1252,12 @@ def main(argv: list[str] | None = None) -> int:
         # (pluss/analysis/transform.py): host math end to end — --check
         # alone boots a device to run the TRANSFORMED spec once
         return _transform_main(args, p, sys.stdout, setup_platform)
+
+    if args.mode == "autotune":
+        # persisted batch-geometry calibration (pluss/autotune.py):
+        # --dry-run only validates the sidecar (no device); a real
+        # calibration times short replays on the live backend
+        return _autotune_main(args, p, sys.stdout, setup_platform)
 
     setup_platform()
 

@@ -1138,11 +1138,16 @@ def _sort_window(np_: NestPlan, refs, ranges, cfg, owned_row, w, nb, bases,
         from pluss.ops import pallas_events
 
         if pallas_events.enabled():
-            # Pallas spike (SURVEY §7 item 10), PLUSS_PALLAS_EVENTS=1:
-            # fused single-pass event histogram; XLA path is the default
+            # fused single-pass event histogram (r19 default on
+            # accelerators; PLUSS_PALLAS_EVENTS / the autotuned geometry
+            # override, compile-probe guarded with the XLA path below as
+            # the loud fallback)
             hist_delta = pallas_events.event_histogram_fused(
                 key_s, pos_s, span_s, valid_s, win_start, pdt)
         else:
+            # event_histogram itself may still run its fused epilogue
+            # (reuse.py dispatch) — this branch only skips the fully
+            # fused carried_events+histogram kernel
             hist_delta = event_histogram(ev)
     else:
         hist_delta = None
@@ -2112,6 +2117,13 @@ def run(spec: LoopNestSpec, cfg: SamplerConfig = DEFAULT,
     results, many short dispatches) with a thread concurrency that fits the
     memory budget — see :func:`_auto_dispatch`.  Disable with
     ``PLUSS_NO_AUTO_DISPATCH=1`` (or by picking a backend explicitly).
+
+    Kernel defaults consult the persisted autotuner: the window
+    histogram's fused-Pallas switch resolves through
+    ``pallas_events.enabled()`` (env > autotuned ``pallas`` field >
+    backend default, compile-probe guarded), and its resolved flavor is
+    folded into every AOT sidecar slot (``plancache._kernel_flavor``) so
+    a flip recompiles instead of replaying the other path's executable.
     """
     from pluss.resilience import faults
 

@@ -553,6 +553,37 @@ def placement_breakdown(counters: dict[str, float],
     return lines
 
 
+def autotune_breakdown(counters: dict[str, float],
+                       gauges: dict[str, float]) -> list[str]:
+    """The fused-kernel / autotuner block (r19): Pallas compile-probe
+    traffic and loud XLA fallbacks, plus the geometry sidecar's consult
+    outcomes — ``hit`` means a later run reused the persisted winner with
+    zero re-calibration, ``stale`` a salt-mismatched or quarantined
+    sidecar, ``probe`` one timed calibration point.  Empty when the
+    stream has neither Pallas nor autotune activity."""
+    keys = ("pallas.probe", "pallas.fallback",
+            "autotune.probe", "autotune.hit", "autotune.stale")
+    if not any(counters.get(k) for k in keys):
+        return []
+    lines = ["kernels & autotune:"]
+    pp = counters.get("pallas.probe")
+    if pp or counters.get("pallas.fallback"):
+        fb = counters.get("pallas.fallback", 0.0)
+        lines.append(f"  {'pallas probes / fallbacks':<28} "
+                     f"{int(pp or 0):>9} / {int(fb)}"
+                     + ("  (fused kernels DISABLED, XLA path)"
+                        if fb else ""))
+    hit = counters.get("autotune.hit")
+    stale = counters.get("autotune.stale")
+    if hit or stale:
+        lines.append(f"  {'geometry hits / stale':<28} "
+                     f"{int(hit or 0):>9} / {int(stale or 0)}")
+    cal = counters.get("autotune.probe")
+    if cal:
+        lines.append(f"  {'calibration points timed':<28} {int(cal):>9}")
+    return lines
+
+
 def render(records: list[dict], out) -> None:
     """Write the human report for one loaded stream."""
     n_spans = sum(1 for r in records if r.get("ev") == "span")
@@ -611,6 +642,9 @@ def render(records: list[dict], out) -> None:
     pblock = placement_breakdown(counters, gauges)
     if pblock:
         out.write("\n".join(pblock) + "\n")
+    ablock = autotune_breakdown(counters, gauges)
+    if ablock:
+        out.write("\n".join(ablock) + "\n")
 
 
 def main(path: str, out, err, check: bool = False) -> int:

@@ -1,0 +1,235 @@
+"""Pallas d24v wire decode: one VMEM pass per block (r19 tentpole).
+
+:func:`pluss.ops.wirecodec.decode_d24v` is a jitted XLA chain — two u32
+gathers, a funnel shift, a per-block ``cumsum``, and a reset-scan — each
+stage a materialized [n_blocks, BLOCK] intermediate making an HBM round
+trip.  This kernel decodes each 1024-id block entirely in VMEM: width-map
+dispatch → nibble unpack → zigzag-delta cumsum → raw-reset carry, writing
+only the final int32 ids (the layout the segmented sort consumes).
+
+Layout: the host wrapper packs each block's payload words into a fixed
+[8, 128] u32 window (max width 6 nibbles = 768 words; zero-padded), so
+every BlockSpec is static — no in-kernel DMA.  The per-block width ``k``
+(0..6 nibbles) is an SMEM scalar; the kernel branches to a width-
+specialized unpack (static reshapes, no gathers — Pallas TPU has no
+vector gather).  The cross-block carry — the last id of block ``b`` seeds
+block ``b+1``'s delta chain; raw blocks reset it absolutely — rides an
+SMEM scratch cell across the sequential grid, replacing the XLA decoder's
+vectorized reset-scan with the sequential original it emulates.
+Bit-identity: int32 addition is associative mod 2^32, so the row-split
+cumsum and the sequential carry reproduce ``decode_d24v``'s flat prefix
+sums exactly (pinned in tests/test_pallas_events.py).
+
+Gated like the events kernel (:mod:`pluss.ops.pallas_events`):
+``PLUSS_PALLAS_DECODE`` > the autotuned ``pallas`` field > accelerator
+default, every affirmative answer behind a one-shot encode/decode
+bit-compare probe that degrades loudly to the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from pluss.ops.wirecodec import BLOCK, RAW_MODE
+
+#: u32 words per packed block window: BLOCK ids * 6 nibbles max = 768
+#: words, padded to 8 sublane rows of 128 lanes
+_ROWS = 8
+
+
+def enabled() -> bool:
+    """Effective fused-decode switch: ``PLUSS_PALLAS_DECODE`` (explicit
+    0/1) > the autotuned geometry's ``pallas`` field > backend default
+    (accelerators on, CPU off — the interpreter run is for tests), all
+    behind the one-shot :func:`probe_ok`.  Honors
+    :func:`pluss.ops.pallas_events.suppress` — shard_map bodies have no
+    pallas_call replication rule to lean on, for decode as for events."""
+    from pluss.ops.pallas_events import _suppressed
+
+    if _suppressed():
+        return False
+    from pluss.utils.envknob import env_bool
+
+    env = env_bool("PLUSS_PALLAS_DECODE", None)
+    if env is not None:
+        return env and probe_ok()
+    from pluss import autotune
+
+    tuned = autotune.consult("pallas")
+    if tuned is not None:
+        return bool(tuned) and probe_ok()
+    if jax.default_backend() == "cpu":
+        return False
+    return probe_ok()
+
+
+def probe_ok() -> bool:
+    """One-shot encode → fused-decode → bit-compare probe per (backend,
+    device kind); failure counts ``pallas.fallback`` and routes the
+    decode back to the XLA chain for the life of the process."""
+    from pluss.ops.pallas_events import _device_kind
+
+    backend = jax.default_backend()
+    return _probe(backend, _device_kind(backend))
+
+
+@functools.lru_cache(maxsize=4)
+def _probe(backend: str, kind: str) -> bool:
+    from pluss import obs
+
+    obs.counter_add("pallas.probe")
+    err = ""
+    try:
+        from pluss.ops.pallas_events import _run_untraced
+
+        ok = bool(_run_untraced(_probe_impl))
+        if not ok:
+            err = "decode mismatch vs wirecodec.decode_d24v"
+    except Exception as e:
+        ok = False
+        err = f"{type(e).__name__}: {e}"
+    if not ok:
+        obs.counter_add("pallas.fallback")
+        print(f"pluss: Pallas d24v decode unavailable on {backend}/"
+              f"{kind} ({err}); using the XLA decode", file=sys.stderr)
+    return ok
+
+
+def _probe_impl() -> bool:
+    """Encode a stream that exercises raw AND delta blocks at several
+    widths, decode both ways, bit-compare the full padded output."""
+    import numpy as np
+
+    from pluss.ops import wirecodec
+
+    rng = np.random.default_rng(0)
+    seq = np.arange(2 * BLOCK, dtype=np.int32) % (1 << 20)
+    rnd = rng.integers(0, 1 << 24, 2 * BLOCK).astype(np.int32)
+    ids = np.concatenate([seq, rnd, seq[::4]])
+    payload, wm = wirecodec.encode_d24v(ids)
+    # the jit executes the pallas_call (no eager eval rule); the caller
+    # runs this whole probe off-trace via pallas_events._run_untraced
+    ref = np.asarray(wirecodec.decode_d24v(
+        jnp.asarray(payload), jnp.asarray(wm)))
+    got = np.asarray(jax.jit(decode_d24v)(
+        jnp.asarray(payload), jnp.asarray(wm)))
+    return np.array_equal(got, ref)
+
+
+def reset_probe() -> None:
+    """Forget probe verdicts and compiled kernels (tests flip env knobs
+    and backends mid-process)."""
+    _probe.cache_clear()
+    _decode_call.cache_clear()
+
+
+def _kernel(meta_ref, win_ref, out_ref, carry_ref):
+    """Decode one 1024-id block from its [8, 128] u32 word window.
+
+    ``meta_ref`` (SMEM): [k nibbles, raw flag].  ``carry_ref`` (SMEM):
+    the running last-id, alive across the sequential grid."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        # explicit int32: under jax x64 a bare Python literal widens to
+        # int64 and the SMEM store rejects the dtype mismatch
+        carry_ref[0] = jnp.int32(0)
+
+    kk_t = meta_ref[0, 0]
+    raw = meta_ref[0, 1]
+    base = carry_ref[0]
+    w = win_ref[:]
+
+    # width-specialized unpack: for a static width kk the value<-nibble
+    # map is a static reshape — nibble m of the block lives in word m>>3
+    # at shift 4*(m&7), and value n owns nibbles [n*kk, n*kk + kk)
+    for kk in range(7):
+        @pl.when(kk_t == kk)
+        def _(kk=kk):
+            if kk == 0:
+                v = jnp.zeros((_ROWS, 128), jnp.uint32)
+            else:
+                nib = jnp.stack(
+                    [(w >> jnp.uint32(4 * j)) & jnp.uint32(0xF)
+                     for j in range(8)], axis=-1)       # [8, 128, 8]
+                nib2 = nib.reshape(_ROWS * 128 * 8)[:BLOCK * kk]
+                nib2 = nib2.reshape(BLOCK, kk)
+                v = nib2[:, 0]
+                for j in range(1, kk):
+                    v = v | (nib2[:, j] << jnp.uint32(4 * j))
+                v = v.reshape(_ROWS, 128)
+            z = v.astype(jnp.int32)
+            d = (z >> 1) ^ -(z & 1)                     # zigzag inverse
+            # flat block prefix sum as row cumsum + exclusive row bases
+            # (int32 addition is associative mod 2^32 — identical bits
+            # to the XLA decoder's single flat cumsum)
+            cs = jnp.cumsum(d, axis=1, dtype=jnp.int32)
+            rt = cs[:, 127:]                            # [8, 1] row totals
+            rb = jnp.cumsum(rt, axis=0, dtype=jnp.int32) - rt
+            out = jnp.where(raw != 0, z, base + rb + cs)
+            out_ref[:] = out
+            carry_ref[0] = out[_ROWS - 1, 127]
+
+
+@functools.lru_cache(maxsize=8)
+def _decode_call(nb: int, backend: str, kind: str):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        _kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, 2), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((_ROWS, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_ROWS, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb * _ROWS, 128), jnp.int32),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        # CPU runs interpreted for the correctness tests; backend + device
+        # kind key the memo so a runtime switch rebuilds
+        interpret=backend == "cpu",
+    )
+
+
+def decode_d24v(payload, wm):
+    """Pallas twin of :func:`pluss.ops.wirecodec.decode_d24v`:
+    ``(payload u8, wm u8) -> int32[n_blocks * BLOCK]``, bit-identical.
+
+    The host-side prep (u32 word assembly + the per-block window gather)
+    is a handful of cheap elementwise/gather ops XLA fuses into the
+    transfer epilogue; everything the XLA chain materialized per stage —
+    bit windows, zigzag values, prefix sums, the reset-scan — stays in
+    VMEM inside the kernel."""
+    from pluss.ops.pallas_events import _device_kind
+
+    k = (wm & 0x7).astype(jnp.int32)
+    raw = ((wm & RAW_MODE) != 0).astype(jnp.int32)
+    nb = int(wm.shape[0])
+    b4 = payload.reshape(-1, 4).astype(jnp.uint32)
+    words = b4[:, 0] | (b4[:, 1] << 8) | (b4[:, 2] << 16) | (b4[:, 3] << 24)
+    # fixed [8, 128]-word window per block: block b's payload occupies
+    # k[b] * 128 words starting at the exclusive prefix of the widths
+    start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(k * 128)[:-1]])
+    t = jnp.arange(_ROWS * 128, dtype=jnp.int32)
+    widx = start[:, None] + t[None, :]
+    keep = t[None, :] < (k[:, None] * 128)
+    wpad = jnp.where(keep,
+                     words[jnp.minimum(widx, words.shape[0] - 1)],
+                     jnp.uint32(0))
+    win = wpad.reshape(nb * _ROWS, 128)
+    meta = jnp.stack([k, raw], axis=1)
+    backend = jax.default_backend()
+    out = _decode_call(nb, backend, _device_kind(backend))(meta, win)
+    return out.reshape(-1)

@@ -1,25 +1,41 @@
-"""Pallas spike (SURVEY §7 build-order item 10): fused event extraction.
+"""Fused Pallas event kernels (SURVEY §7 build-order item 10, promoted r19).
 
-One TPU kernel fuses the post-sort event phase of a window —
-:func:`pluss.ops.reuse.carried_events` + :func:`event_histogram` — into a
-single VMEM pass: boundary detection, carried/cold classification, reuse
-differences, share masking, log2 binning, and the [NBINS] histogram
-accumulation, instead of XLA's fused elementwise prologue + one-hot matmul
-epilogue.  The sort itself stays on XLA's native sort (a hand-written
-Pallas replacement was evaluated and rejected: a sequential scalar LAT
-walk costs ~30 cycles/element on the scalar unit — slower than the vector
+Two TPU kernels fuse the post-sort event phase into single VMEM passes:
+
+- :func:`event_histogram_fused` — the engine's ghost-merged window path:
+  :func:`pluss.ops.reuse.carried_events` + :func:`event_histogram` in one
+  kernel (boundary detection, carried/cold classification, reuse
+  differences, share masking, log2 binning, [NBINS] accumulation).
+- :func:`fused_event_histogram` — the shared post-gather consumer behind
+  :func:`pluss.ops.reuse.event_histogram`: log2 binning + the one-hot
+  histogram reduction of an already-classified event dict (trace batches,
+  both sharded dispatch modes, and the engine's non-fused windows all
+  funnel through it).
+
+The sort itself stays on XLA's native sort (a hand-written Pallas
+replacement was evaluated and rejected: a sequential scalar LAT walk
+costs ~30 cycles/element on the scalar unit — slower than the vector
 sort pipeline it would replace; see PARITY.md round-4 notes).
 
-Strictly flag-gated (``PLUSS_PALLAS_EVENTS=1``) with the XLA path as the
-default and fallback: round 3's packed-sort spike taught that novel
-kernels can fault this image's TPU worker, so the default path must never
-depend on one.  A/B numbers live in PARITY.md.
+Promoted from flag-gated spike to the accelerator DEFAULT in r19, with
+the XLA path as automatic fallback: :func:`enabled` resolves
+``PLUSS_PALLAS_EVENTS`` (envknob bool — ``=0`` really means off) > the
+autotuned geometry's ``pallas`` field > backend default (on for
+accelerators, off for CPU where the kernel runs interpreted), and every
+affirmative answer is subject to :func:`probe_ok` — a one-shot
+compile-AND-compare probe per (backend, device kind), the PR-11
+``serialize_executable`` probe discipline: round 3's packed-sort spike
+taught that novel kernels can fault this image's TPU worker, so a
+lowering failure degrades loudly to the XLA path (``pallas.fallback``
+counted), never a crash.  A/B numbers live in PARITY.md.
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
-import os
+import sys
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -31,8 +47,162 @@ from pluss.config import NBINS
 BLOCK = 8 * 1024
 
 
+def _device_kind(backend: str) -> str:
+    """Device kind of the backend's first device — part of every kernel
+    memo key so a TPU-generation switch under one backend string rebuilds
+    instead of replaying a stale lowering (mirrors
+    ``plancache._runtime_salt``)."""
+    try:
+        return jax.devices(backend)[0].device_kind
+    except Exception:
+        return "unknown"
+
+
+_tls = threading.local()
+
+
+def _suppressed() -> bool:
+    return getattr(_tls, "suppress", False)
+
+
+@contextlib.contextmanager
+def suppress():
+    """Force the XLA path for the duration of the context.
+
+    ``pallas_call`` has no ``shard_map`` replication rule, so the fused
+    dispatch inside :func:`pluss.ops.reuse.event_histogram` would abort
+    the trace of any shard_map program that reaches it.  The shard bodies
+    (both dispatch frontends) wrap their trace in this context so the
+    switch resolves False exactly there; the host-side pipeline around
+    them keeps its fused kernels.  Thread-local, like jax trace state."""
+    prev = getattr(_tls, "suppress", False)
+    _tls.suppress = True
+    try:
+        yield
+    finally:
+        _tls.suppress = prev
+
+
+def suppressing(fn):
+    """``fn`` wrapped to trace/run under :func:`suppress` — the one-line
+    form shard_map call sites use."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with suppress():
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
 def enabled() -> bool:
-    return bool(os.environ.get("PLUSS_PALLAS_EVENTS"))
+    """Effective fused-events switch for the current backend.
+
+    Resolution order: :func:`suppress` context (shard_map bodies, always
+    off) > ``PLUSS_PALLAS_EVENTS`` (explicit 0/1, envknob policy) > the
+    autotuned geometry's ``pallas`` field
+    (:func:`pluss.autotune.consult`) > backend default — on for
+    accelerators, off for the CPU backend, where the kernel runs in the
+    (slow) interpreter and exists for correctness testing only.  Any
+    affirmative answer still passes through :func:`probe_ok`: a Pallas
+    lowering failure on this runtime degrades loudly to the XLA path.
+    """
+    if _suppressed():
+        return False
+    from pluss.utils.envknob import env_bool
+
+    env = env_bool("PLUSS_PALLAS_EVENTS", None)
+    if env is not None:
+        return env and probe_ok()
+    from pluss import autotune
+
+    tuned = autotune.consult("pallas")
+    if tuned is not None:
+        return bool(tuned) and probe_ok()
+    if jax.default_backend() == "cpu":
+        return False
+    return probe_ok()
+
+
+def probe_ok() -> bool:
+    """One-shot compile-AND-compare probe of the fused histogram kernel
+    on the active (backend, device kind); memoized like the PR-11 AOT
+    probe.  False (counted + one stderr line) routes every consumer back
+    to the XLA path for the life of the process."""
+    backend = jax.default_backend()
+    return _probe(backend, _device_kind(backend))
+
+
+def _run_untraced(fn):
+    """Run ``fn`` on a fresh thread: trace state is thread-local, so a
+    probe fired at TRACE time of an enclosing jit still compiles and RUNS
+    its kernel eagerly there (an in-trace run would fold the kernel into
+    the outer jaxpr, where its failure could not be caught)."""
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+        return ex.submit(fn).result()
+
+
+@functools.lru_cache(maxsize=4)
+def _probe(backend: str, kind: str) -> bool:
+    from pluss import obs
+
+    obs.counter_add("pallas.probe")
+    err = ""
+    try:
+        ok = bool(_run_untraced(lambda: _probe_impl(backend, kind)))
+        if not ok:
+            err = "histogram mismatch vs the XLA reference"
+    except Exception as e:  # lowering/compile/runtime — all degrade
+        ok = False
+        err = f"{type(e).__name__}: {e}"
+    if not ok:
+        obs.counter_add("pallas.fallback")
+        print(f"pluss: Pallas events kernel unavailable on {backend}/"
+              f"{kind} ({err}); using the XLA path", file=sys.stderr)
+    return ok
+
+
+def _probe_impl(backend: str, kind: str) -> bool:
+    """Run one BLOCK of synthetic classified events through the fused
+    kernel and bit-compare against a host-side reference binning."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    n = BLOCK
+    reuse = rng.integers(1, 1 << 20, n).astype(np.int32)
+    evt = rng.random(n) < 0.5
+    cold = ~evt & (rng.random(n) < 0.25)
+    # the explicit jit executes the pallas_call (it has no eager eval
+    # rule); _run_untraced keeps this off any enclosing trace
+    fused = np.asarray(jax.jit(_masked_hist)(
+        jnp.asarray(reuse), jnp.asarray(evt.astype(np.int32)),
+        jnp.asarray((evt | cold).astype(np.int32))))
+    bits = np.frexp(np.maximum(reuse, 1).astype(np.float64))[1]
+    bins = np.where(evt, bits, 0)
+    ref = np.bincount(bins, weights=(evt | cold).astype(np.int64),
+                      minlength=128)[:NBINS].astype(np.int64)
+    return np.array_equal(fused.astype(np.int64), ref)
+
+
+def reset_probe() -> None:
+    """Forget probe verdicts and compiled kernels (tests + re-calibration
+    flip env knobs and backends mid-process)."""
+    _probe.cache_clear()
+    _event_hist_fn.cache_clear()
+    _masked_hist_fn.cache_clear()
+
+
+def _padded_n(n: int) -> int:
+    """BLOCK-multiple padded length, quantized eighth-octave past 8
+    blocks (the ``wirecodec.pad_len`` shape trick): ragged windows land
+    on a handful of padded lengths instead of one kernel retrace per
+    distinct length, wasting <= ~12.5% of the pass on masked-out tail."""
+    nb = -(-n // BLOCK)
+    if nb > 8:
+        q = max(1, (1 << (nb.bit_length() - 1)) // 8)
+        nb = -(-nb // q) * q
+    return nb * BLOCK
 
 
 def _kernel(key_ref, prev_key_ref, pos_ref, prev_pos_ref, span_ref,
@@ -60,16 +230,22 @@ def _kernel(key_ref, prev_key_ref, pos_ref, prev_pos_ref, span_ref,
     bins = jnp.where(evt, (bits - jax.lax.clz(jnp.maximum(reuse, 1))),
                      0).astype(jnp.int32)
     wgt = (evt | cold).astype(jnp.float32)
-    # histogram over the [ROWS, 128] block without reshape: compare the
-    # block against each lane-aligned bin id and reduce — 128 padded bins
-    # (the host slices [:NBINS]); one [ROWS, 128, 128] masked reduction
+    _accumulate(i, bins, wgt, hist_ref)
+
+
+def _accumulate(i, bins, wgt, hist_ref):
+    """Shared one-hot epilogue of both kernels: compare the [ROWS, 128]
+    block against each lane-aligned bin id and reduce — 128 padded bins
+    (the host slices [:NBINS]); one [ROWS, 128, 128] masked reduction, no
+    reshape.  Per-block counts are exact in f32 (<= BLOCK < 2^24); the
+    CROSS-block accumulator is int32 so totals stay exact past 2^24 (the
+    XLA path's ``bin_histogram`` keeps the same contract by chunking its
+    one-hot matmuls and accumulating the exact per-chunk results in the
+    integer weight dtype — pluss/ops/reuse.py bin_histogram)."""
+    from jax.experimental import pallas as pl
+
     ids = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 128), 2)
     oh = (bins[:, :, None] == ids).astype(jnp.float32)
-    # per-block counts are exact in f32 (<= BLOCK < 2^24); the CROSS-block
-    # accumulator is int32 so totals stay exact past 2^24 (the XLA path's
-    # bin_histogram keeps the same contract by chunking its one-hot
-    # matmuls and accumulating the exact per-chunk results in the integer
-    # weight dtype — pluss/ops/reuse.py bin_histogram)
     local = jnp.sum(oh * wgt[:, :, None],
                     axis=(0, 1))[None, :].astype(jnp.int32)
 
@@ -84,43 +260,120 @@ def _kernel(key_ref, prev_key_ref, pos_ref, prev_pos_ref, span_ref,
         hist_ref[:] = hist_ref[:] + local
 
 
-@functools.lru_cache(maxsize=8)
-def _event_hist_fn(n: int, pos_dtype_name: str, backend: str):
+def _hist_kernel(reuse_ref, evt_ref, wgt_ref, hist_ref):
+    """Post-gather block -> accumulate: log2 binning + histogram of an
+    already-classified event stream (``evt``/``wgt`` arrive as int32
+    masks; padding is all-zero and weighs nothing)."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    reuse = reuse_ref[:]
+    evt = evt_ref[:] != 0
+    bins = jnp.where(evt, 32 - jax.lax.clz(jnp.maximum(reuse, 1)),
+                     0).astype(jnp.int32)
+    wgt = (wgt_ref[:] != 0).astype(jnp.float32)
+    _accumulate(i, bins, wgt, hist_ref)
+
+
+def _specs(n: int, n_in: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if n % BLOCK:
         raise ValueError(f"stream length {n} not a multiple of {BLOCK}")
     rows = BLOCK // 128
-    grid = (n // BLOCK,)
     # inputs arrive reshaped [n//128, 128] (TPU blocks need 2-D tiles with
     # lane dim 128); index_map returns BLOCK indices (block units)
     blk = lambda i: (i, 0)
-    specs = [pl.BlockSpec((rows, 128), blk, memory_space=pltpu.VMEM)
-             for _ in range(6)]
+    in_specs = [pl.BlockSpec((rows, 128), blk, memory_space=pltpu.VMEM)
+                for _ in range(n_in)]
+    out_spec = pl.BlockSpec((1, 128), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    return (n // BLOCK,), in_specs, out_spec
+
+
+@functools.lru_cache(maxsize=8)
+def _event_hist_fn(n: int, pos_dtype_name: str, backend: str, kind: str):
+    from jax.experimental import pallas as pl
+
+    grid, in_specs, out_spec = _specs(n, 6)
     return pl.pallas_call(
         _kernel,
         grid=grid,
-        in_specs=specs,
-        out_specs=pl.BlockSpec((1, 128), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM),
+        in_specs=in_specs,
+        out_specs=out_spec,
         out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32),
         # the CPU backend runs the kernel in the interpreter — correctness
         # tests exercise the same code path the TPU compiles.  ``backend``
-        # is part of the memo key, so a backend switch rebuilds.
+        # and the device kind are part of the memo key, so a backend (or
+        # TPU-generation) switch rebuilds instead of replaying a stale
+        # lowering.
         interpret=backend == "cpu",
     )
+
+
+@functools.lru_cache(maxsize=8)
+def _masked_hist_fn(n: int, backend: str, kind: str):
+    from jax.experimental import pallas as pl
+
+    grid, in_specs, out_spec = _specs(n, 3)
+    return pl.pallas_call(
+        _hist_kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((1, 128), jnp.int32),
+        interpret=backend == "cpu",
+    )
+
+
+def _masked_hist(reuse, evt_i, wgt_i):
+    """[NBINS] int32 histogram of BLOCK-padded (reuse, evt, wgt) arrays."""
+    backend = jax.default_backend()
+    fn = _masked_hist_fn(int(reuse.shape[0]), backend,
+                         _device_kind(backend))
+    r2 = lambda a: a.reshape(-1, 128)
+    hist = fn(r2(reuse), r2(evt_i), r2(wgt_i))
+    return hist[0, :NBINS]
+
+
+def fits(ev: dict) -> bool:
+    """Whether :func:`fused_event_histogram` can serve this event dict:
+    int32 reuse only (the int64-position regime past 2^31 refs stays on
+    the XLA path) and the fused default resolved on."""
+    return ev["reuse"].dtype == jnp.int32 and enabled()
+
+
+def fused_event_histogram(ev: dict, include_cold: bool = True):
+    """Fused drop-in for the binning + one-hot histogram epilogue of
+    :func:`pluss.ops.reuse.event_histogram`; the caller guards with
+    :func:`fits`.  Classification masks are elementwise (XLA fuses them
+    into the operand prep); the kernel owns binning and the reduction.
+    """
+    reuse = ev["reuse"]
+    evt = ev["is_evt"] & ~ev["share"]
+    w = (ev["cold"] | evt) if include_cold else evt
+    n = int(reuse.shape[0])
+    pad = _padded_n(n) - n
+    evt_i = evt.astype(jnp.int32)
+    w_i = w.astype(jnp.int32)
+    if pad:
+        z = jnp.zeros((pad,), jnp.int32)
+        reuse = jnp.concatenate([reuse, z])
+        evt_i = jnp.concatenate([evt_i, z])
+        w_i = jnp.concatenate([w_i, z])
+    return _masked_hist(reuse, evt_i, w_i).astype(ev["reuse"].dtype)
 
 
 def event_histogram_fused(key_s, pos_s, span_s, valid_i, win_start, pdt):
     """[NBINS] histogram of one ghost-merged sorted window, one fused pass.
 
     Drop-in for ``event_histogram(carried_events(...))``; the caller pads
-    the window to a BLOCK multiple (invalid tail sorts last, so padding
-    with sentinel-invalid entries is safe).
+    the window to a (quantized) BLOCK multiple — the invalid tail sorts
+    last, so padding with sentinel-invalid entries is safe.
     """
-    n = key_s.shape[0]
-    pad = (-n) % BLOCK
+    n = int(key_s.shape[0])
+    pad = _padded_n(n) - n
     if pad:
         key_s = jnp.concatenate([key_s, jnp.full((pad,), -1, key_s.dtype)])
         pos_s = jnp.concatenate([pos_s, jnp.zeros((pad,), pos_s.dtype)])
@@ -131,8 +384,9 @@ def event_histogram_fused(key_s, pos_s, span_s, valid_i, win_start, pdt):
                                 key_s[:-1]])
     prev_pos = jnp.concatenate([pos_s[:1], pos_s[:-1]])
     real = ((valid_i != 0) & (pos_s >= win_start)).astype(jnp.int32)
+    backend = jax.default_backend()
     fn = _event_hist_fn(int(key_s.shape[0]), jnp.dtype(pdt).name,
-                        jax.default_backend())
+                        backend, _device_kind(backend))
     r2 = lambda a: a.reshape(-1, 128)
     hist = fn(r2(key_s), r2(prev_key), r2(pos_s), r2(prev_pos),
               r2(span_s), r2(real))

@@ -311,7 +311,17 @@ def event_histogram(ev: dict, include_cold: bool = True) -> jnp.ndarray:
     ``include_cold=False`` drops the cold weight — the sharded backend's
     device-local "cold" entries are unresolved heads, settled only after the
     cross-device tail exchange.
+
+    When the fused Pallas consumer is resolved on (accelerator default
+    since r19; ``PLUSS_PALLAS_EVENTS`` / the autotuned geometry override,
+    compile-probe guarded), the binning + one-hot reduction run as one
+    VMEM kernel — bit-identical by the equivalence matrix in
+    tests/test_pallas_events.py; otherwise the XLA epilogue below.
     """
+    from pluss.ops import pallas_events
+
+    if pallas_events.fits(ev):
+        return pallas_events.fused_event_histogram(ev, include_cold)
     evt = ev["is_evt"] & ~ev["share"]
     bins = jnp.where(evt, log2_bin(ev["reuse"]), 0)
     w = ((ev["cold"] | evt) if include_cold else evt).astype(ev["reuse"].dtype)

@@ -472,8 +472,13 @@ def _compiled(spec: LoopNestSpec, cfg: SamplerConfig, share_cap: int,
     D = mesh.devices.size
     pl, S = _shard_geometry(spec, cfg, D, assignment, start_point,
                             window_accesses)
+    from pluss.ops import pallas_events
+
+    # suppressing(): no pallas_call replication rule under shard_map —
+    # the body's event_histogram dispatch must bake in the XLA path
     f = compat.shard_map(
-        lambda t: _shard_body(t, pl, share_cap, D, S, segmented),
+        pallas_events.suppressing(
+            lambda t: _shard_body(t, pl, share_cap, D, S, segmented)),
         mesh=mesh,
         in_specs=P(),
         out_specs=P(),

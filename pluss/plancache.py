@@ -132,13 +132,29 @@ def _aot_probe(backend: str) -> bool:
         return False
 
 
+def _kernel_flavor() -> tuple:
+    """The resolved fused-kernel configuration this process traces with
+    (events + d24v decode, Pallas vs XLA) — folded into every AOT slot
+    hash: the two paths are bit-identical but their EXECUTABLES are not,
+    so a flavor flip (env knob, autotune geometry, probe fallback) must
+    land on a different sidecar rather than replay the other path's
+    bytes.  Deliberately NOT part of :func:`runtime_salt`: the autotuner
+    sidecar is keyed by the salt and itself feeds this resolution — a
+    salt that depended on it would chase its own tail."""
+    from pluss.ops import pallas_decode, pallas_events
+
+    return ("ev-pallas" if pallas_events.enabled() else "ev-xla",
+            "dec-pallas" if pallas_decode.enabled() else "dec-xla")
+
+
 def aot_path(group: str | None, parts: tuple) -> str | None:
     """Disk slot for one serialized executable, or None when the plan
     cache is off or the plan has no stable group key.  ``group`` is the
     owning plan-cache entry's key (sidecars of one entry share its
     prefix, so eviction unlinks them as a unit); ``parts`` identify the
     executable within the group (backend path, segment, slice length,
-    thread batch, share cap)."""
+    thread batch, share cap) — the resolved kernel flavor
+    (:func:`_kernel_flavor`) rides alongside them."""
     if group is None:
         return None
     from pluss import engine
@@ -149,7 +165,8 @@ def aot_path(group: str | None, parts: tuple) -> str | None:
     import hashlib
 
     slot = hashlib.sha256(
-        repr((runtime_salt(),) + parts).encode()).hexdigest()[:16]
+        repr((runtime_salt(), _kernel_flavor()) + parts).encode()
+    ).hexdigest()[:16]
     os.makedirs(root, exist_ok=True)
     return os.path.join(root, f"{group}.aot-{slot}.exe")
 

@@ -374,8 +374,19 @@ class Server:
             self._warm_done.set()
 
     def _warm_loop_inner(self) -> None:
-        from pluss import engine
+        import sys
 
+        from pluss import autotune, engine
+
+        # announce the persisted autotuned geometry (r19) — trace warms
+        # below resolve their window through it, so the residency entry
+        # and first real requests share one compiled plan
+        geo = autotune.tuned_geometry()
+        if geo:
+            obs.event("serve.warm_geometry", **geo)
+            print("pluss serve: warming with autotuned geometry "
+                  + " ".join(f"{k}={geo[k]}" for k in sorted(geo)),
+                  file=sys.stderr)
         warmed = 0
         try:
             objs = _warm_objs(self.config.warm)
@@ -393,9 +404,12 @@ class Server:
                     from pluss import trace as trace_mod
 
                     with obs.span("serve.warm", trace=req.trace):
+                        # _resolve_window consults the autotuned
+                        # geometry before the TRACE_WINDOW default
                         trace_mod.ensure_resident(
                             req.trace, cls=req.cfg.cls,
-                            window=req.window or trace_mod.TRACE_WINDOW)
+                            window=req.window
+                            or trace_mod._resolve_window(None))
                 else:
                     with obs.span("serve.warm", model=obj.get("model")):
                         engine.precompile(req.spec, req.cfg, req.share_cap,

@@ -122,15 +122,81 @@ def _env_int(name: str, default: int, minimum: int = 1) -> int:
 WINDOWS_PER_BATCH = _env_int("PLUSS_BATCH_WINDOWS", 16)
 
 
+def _tuned(field: str):
+    """The autotuned geometry's value for one replay knob, or None.
+    Consulted LAST in every default resolution — explicit kwargs and
+    PLUSS_* env overrides always win; the tuned value only replaces the
+    shipped backend guess (:mod:`pluss.autotune`)."""
+    from pluss import autotune
+
+    return autotune.consult(field)
+
+
+def _resolve_window(window: int | None) -> int:
+    """The effective replay window: explicit kwarg > autotuned geometry
+    > :data:`TRACE_WINDOW`.  Histograms are window-invariant (PR-4:
+    reuse gaps are partition-invariant), so the tuned value is purely a
+    throughput knob — but it IS part of the checkpoint identity, so it
+    resolves once, up front."""
+    if window is not None:
+        w = int(window)
+        if w < 1:
+            raise ValueError(f"window must be >= 1, got {w}")
+        return w
+    t = _tuned("window")
+    return int(t) if t else TRACE_WINDOW
+
+
 def _resolve_bw(batch_windows: int | None) -> int:
     """The effective windows-per-batch, validated.  A non-positive value
     must fail loudly here: ``batch_windows=-4`` would otherwise return an
     all-zero histogram that still claims full coverage (zero batches
-    dispatched), and 0 would silently alias the default."""
-    bw = WINDOWS_PER_BATCH if batch_windows is None else int(batch_windows)
+    dispatched), and 0 would silently alias the default.  Default chain:
+    kwarg > PLUSS_BATCH_WINDOWS > autotuned geometry > 16."""
+    if batch_windows is None:
+        bw = None
+        if "PLUSS_BATCH_WINDOWS" not in os.environ:
+            bw = _tuned("batch_windows")
+        bw = int(bw) if bw else WINDOWS_PER_BATCH
+    else:
+        bw = int(batch_windows)
     if bw < 1:
         raise ValueError(f"batch_windows must be >= 1, got {bw}")
     return bw
+
+
+def _resolve_stage_depth(stage_depth: int | None) -> int:
+    """Staged-ahead device batches: kwarg > PLUSS_TRACE_STAGE_DEPTH >
+    autotuned geometry > 2 (the classic double buffer)."""
+    if stage_depth is None:
+        if "PLUSS_TRACE_STAGE_DEPTH" not in os.environ:
+            t = _tuned("stage_depth")
+            if t:
+                return int(t)
+        return _env_int("PLUSS_TRACE_STAGE_DEPTH", 2)
+    sd = int(stage_depth)
+    if sd < 1:
+        # depth 0 would stage nothing and replay zero batches while
+        # claiming success — same failure class as batch_windows<1
+        raise ValueError(f"stage_depth must be >= 1, got {sd}")
+    return sd
+
+
+def _resolve_queue_depth(queue_depth: int | None) -> int:
+    """Feed queue bound: kwarg > PLUSS_TRACE_QUEUE_DEPTH > autotuned
+    geometry > 2."""
+    if queue_depth is None:
+        if "PLUSS_TRACE_QUEUE_DEPTH" not in os.environ:
+            t = _tuned("queue_depth")
+            if t:
+                return int(t)
+        return _env_int("PLUSS_TRACE_QUEUE_DEPTH", 2)
+    qd = int(queue_depth)
+    if qd < 1:
+        # queue.Queue(maxsize=0) means UNBOUNDED — the reader would buffer
+        # the whole trace and break the bounded-host-memory contract
+        raise ValueError(f"queue_depth must be >= 1, got {qd}")
+    return qd
 
 
 def _segmented_default() -> bool:
@@ -170,6 +236,9 @@ def _resolve_wire(wire: str | None) -> str:
             f"unknown wire format {wire!r} (choices: "
             f"{', '.join(WIRE_CHOICES)})")
     if wire == "auto":
+        t = _tuned("wire")
+        if t in ("pack", "d24v"):
+            return t
         return "d24v" if jax.default_backend() != "cpu" else "pack"
     return wire
 
@@ -192,6 +261,10 @@ def _resolve_feed_workers(feed_workers: int | None) -> int:
     a malformed PLUSS_FEED_WORKERS warns and falls back to the backend
     default, same as every other env knob."""
     if feed_workers is None:
+        if "PLUSS_FEED_WORKERS" not in os.environ:
+            t = _tuned("feed_workers")
+            if t:
+                return int(t)
         return _env_int("PLUSS_FEED_WORKERS", _default_feed_workers())
     fw = int(feed_workers)
     if fw < 1:
@@ -508,26 +581,55 @@ def _compact_stage(comp, shift: int, precompacted: bool, snapshot: bool):
     return compact_batch
 
 
-@functools.lru_cache(maxsize=4)
-def _decode_fn(backend: str):
-    """Jitted d24v -> int32 expansion (pluss.ops.wirecodec.decode_d24v).
-    A SEPARATE executable from the replay kernel, so the handful of
-    payload shapes (wirecodec.pad_len quantizes them) retrace only this
-    small decode — never the batch sort."""
+def _decode_impl(fused: bool):
+    """The d24v decoder implementation behind both jitted wrappers: the
+    Pallas VMEM kernel (:mod:`pluss.ops.pallas_decode`) when the fused
+    flag resolved on, else the XLA chain — bit-identical by the r19
+    equivalence matrix, so the choice is pure throughput."""
+    if fused:
+        from pluss.ops import pallas_decode
+
+        return pallas_decode.decode_d24v
     from pluss.ops import wirecodec
 
-    return jax.jit(wirecodec.decode_d24v)
+    return wirecodec.decode_d24v
 
 
-@functools.lru_cache(maxsize=4)
+def _decode_fused() -> bool:
+    """Resolve the fused-decode flag OUTSIDE the jitted wrappers (probe
+    runs eagerly here, and the memo keys stay honest across env/autotune
+    flips mid-process)."""
+    from pluss.ops import pallas_decode
+
+    return pallas_decode.enabled()
+
+
+def _decode_fn(backend: str):
+    """Jitted d24v -> int32 expansion (``wirecodec.decode_d24v`` or its
+    Pallas twin).  A SEPARATE executable from the replay kernel, so the
+    handful of payload shapes (wirecodec.pad_len quantizes them) retrace
+    only this small decode — never the batch sort."""
+    return _decode_fn_cached(backend, _decode_fused())
+
+
+@functools.lru_cache(maxsize=8)
+def _decode_fn_cached(backend: str, fused: bool):
+    return jax.jit(_decode_impl(fused))
+
+
 def _stage_decode_fn(backend: str):
     """Jitted d24v record -> the resident u24 byte layout: the
     PCIe/tunnel carries the compressed record, HBM holds the same
     3 B/ref layout :func:`replay_staged` already consumes."""
-    from pluss.ops import wirecodec
+    return _stage_decode_fn_cached(backend, _decode_fused())
+
+
+@functools.lru_cache(maxsize=8)
+def _stage_decode_fn_cached(backend: str, fused: bool):
+    decode = _decode_impl(fused)
 
     def f(payload, wm, count, batch):
-        ids = wirecodec.decode_d24v(payload, wm)
+        ids = decode(payload, wm)
         ids = jnp.zeros((batch,), jnp.int32).at[:count].set(ids[:count])
         u = ids.astype(jnp.uint32)
         return jnp.stack(
@@ -561,9 +663,14 @@ def _replay_fn(window: int, pos_dtype_name: str,
         segmented = _segmented_default()
     # the donation decision is backend-dependent, so the backend is part of
     # the cache key — a force_cpu fallback after an accelerator run must not
-    # reuse a donating executable (and vice versa)
+    # reuse a donating executable (and vice versa).  The fused-events flag
+    # is resolved HERE (outside the jit — the probe may compile) and keyed:
+    # an env/autotune flip mid-process retraces instead of replaying the
+    # other path's executable.
+    from pluss.ops import pallas_events
+
     return _replay_fn_cached(window, pos_dtype_name, jax.default_backend(),
-                             bool(segmented))
+                             bool(segmented), pallas_events.enabled())
 
 
 def _scan_batch(last_pos, hist, base, ids, n_valid, window: int, pdt):
@@ -640,7 +747,9 @@ def _trace_cache_salt() -> str:
 
     h = hashlib.sha256()
     here = os.path.dirname(os.path.abspath(__file__))
-    for name in ("trace.py", os.path.join("ops", "reuse.py")):
+    for name in ("trace.py", os.path.join("ops", "reuse.py"),
+                 os.path.join("ops", "pallas_events.py"),
+                 os.path.join("ops", "pallas_decode.py")):
         with open(os.path.join(here, name), "rb") as f:
             h.update(f.read())
     return h.hexdigest()[:16]
@@ -648,7 +757,7 @@ def _trace_cache_salt() -> str:
 
 @functools.lru_cache(maxsize=32)
 def _replay_fn_cached(window: int, pos_dtype_name: str, backend: str,
-                      segmented: bool):
+                      segmented: bool, fused: bool):
     import hashlib
 
     pdt = jnp.dtype(pos_dtype_name)
@@ -663,14 +772,16 @@ def _replay_fn_cached(window: int, pos_dtype_name: str, backend: str,
     # per batch, so donate only off-CPU (there the copy is cheap anyway)
     donate = (0, 1) if backend != "cpu" else ()
     group = hashlib.sha256(repr(
-        (_trace_cache_salt(), "trace", window, pos_dtype_name, segmented)
+        (_trace_cache_salt(), "trace", window, pos_dtype_name, segmented,
+         fused)
     ).encode()).hexdigest()[:32]
     # per-shape AOT over the jit: the replay step retraces on table growth
     # / --batch-windows, so each signature gets its own sidecar slot
     from pluss import plancache
 
-    return plancache.LazyAotFn(jax.jit(run, donate_argnums=donate), group,
-                               ("trace", window, pos_dtype_name, segmented))
+    return plancache.LazyAotFn(
+        jax.jit(run, donate_argnums=donate), group,
+        ("trace", window, pos_dtype_name, segmented, fused))
 
 
 def replay(addrs: np.ndarray, cls: int = 64, window: int = TRACE_WINDOW,
@@ -949,7 +1060,7 @@ def _ckpt_load(path: str, n: int, window: int, cls: int,
 
 
 def replay_file(path: str, fmt: str = "u64", cls: int = 64,
-                window: int = TRACE_WINDOW, precompacted: bool = False,
+                window: int | None = None, precompacted: bool = False,
                 initial_capacity: int = 1 << 20,
                 limit_refs: int | None = None,
                 pipeline: bool = True,
@@ -1027,7 +1138,14 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     recomputing only the batches after the last checkpoint (``pluss trace
     --resume``).  A checkpoint for a different (refs, window) shape is
     ignored with a notice, never silently mixed in.
+
+    Every None-defaulted geometry knob (``window``, ``batch_windows``,
+    ``queue_depth``, ``feed_workers``, ``wire``, ``stage_depth``, and the
+    fused Pallas kernels) resolves through the persisted autotuner
+    (:mod:`pluss.autotune`) before falling back to the shipped backend
+    guess — explicit kwargs and PLUSS_* env overrides always win.
     """
+    window = _resolve_window(window)
     if fmt == "text":  # line-oriented; no random access worth streaming
         return replay(load_trace(path, fmt), cls, window,
                       precompacted=precompacted,
@@ -1078,14 +1196,7 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     pdt = np.dtype(pos_dtype)
     wirefmt = _resolve_wire(wire)
     workers = _resolve_feed_workers(feed_workers)
-    if stage_depth is None:
-        sd = _env_int("PLUSS_TRACE_STAGE_DEPTH", 2)
-    else:
-        sd = int(stage_depth)
-        if sd < 1:
-            # depth 0 would stage nothing and replay zero batches while
-            # claiming success — same failure class as batch_windows<1
-            raise ValueError(f"stage_depth must be >= 1, got {sd}")
+    sd = _resolve_stage_depth(stage_depth)
 
     b0 = 0
     comp0 = _Compactor()
@@ -1144,12 +1255,7 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
     # ``pipeline=False`` runs the same stages inline (debugging / A-B).
     import contextlib
 
-    qd = queue_depth if queue_depth is not None else \
-        _env_int("PLUSS_TRACE_QUEUE_DEPTH", 2)
-    if qd < 1:
-        # queue.Queue(maxsize=0) means UNBOUNDED — the reader would buffer
-        # the whole trace and break the bounded-host-memory contract
-        raise ValueError(f"queue_depth must be >= 1, got {qd}")
+    qd = _resolve_queue_depth(queue_depth)
     if not pipeline:
         src = contextlib.nullcontext(batches())
     elif workers > 1:
@@ -1824,7 +1930,7 @@ def _stage_fn(backend: str):
 
 @functools.lru_cache(maxsize=8)
 def _resident_fn(window: int, pos_dtype_name: str, backend: str,
-                 segmented: bool):
+                 segmented: bool, fused: bool):
     """One-dispatch replay over the device-resident packed trace: an outer
     scan over batches, each batch the same kernel as the streamed path
     (segmented whole-batch by default; per-window legacy scan for A/B).
@@ -2050,8 +2156,10 @@ def replay_staged(resident, n_lines: int, n_run: int,
     pdt = np.dtype(pos_dtype)
     if segmented is None:
         segmented = _segmented_default()
+    from pluss.ops import pallas_events
+
     fn = _resident_fn(window, pos_dtype, jax.default_backend(),
-                      bool(segmented))
+                      bool(segmented), pallas_events.enabled())
     last_pos = jnp.full((n_lines,), -1, pdt)
     hist = jnp.zeros((NBINS,), pdt)
     t0 = time.perf_counter()
@@ -2160,16 +2268,20 @@ def shard_replay(addrs: np.ndarray, cls: int = 64, mesh=None,
             cold.sum().astype(pdt))
         return jax.lax.psum(hist, "d")
 
+    from pluss.ops import pallas_events
     from pluss.utils import compat
 
-    f = jax.jit(compat.shard_map(body, mesh=mesh, in_specs=P("d"),
+    # suppressing(): no pallas_call replication rule under shard_map —
+    # the body's event_histogram dispatch must bake in the XLA path
+    f = jax.jit(compat.shard_map(pallas_events.suppressing(body),
+                                 mesh=mesh, in_specs=P("d"),
                                  out_specs=P()))
     hist = f(ids3)
     return ReplayResult(np.asarray(hist, np.int64), n, n_lines)
 
 
 @functools.lru_cache(maxsize=8)
-def _steal_chunk_fn(backend: str, pos_dtype_name: str):
+def _steal_chunk_fn(backend: str, pos_dtype_name: str, fused: bool = False):
     """Per-device chunk executable of the work-stealing sharded replay:
     ONE :func:`pluss.ops.reuse.batch_events` call covers the whole chunk
     (the PR-4 segmented kernel — sort, carried gather, tail scatter), with
@@ -2259,7 +2371,9 @@ def _shard_replay_file_steal(path: str, cls: int, mesh, window: int,
             f"trace of {n} accesses needs int64 positions; enable "
             "jax_enable_x64")
     npdt = np.dtype(pos_dtype)
-    fn = _steal_chunk_fn(jax.default_backend(), pos_dtype)
+    from pluss.ops import pallas_events as _pe
+
+    fn = _steal_chunk_fn(jax.default_backend(), pos_dtype, _pe.enabled())
 
     res_store = res_key = None
     if resident_cache:
@@ -2517,8 +2631,11 @@ def shard_replay_file(path: str, cls: int = 64, mesh=None,
                 (k0 + jnp.arange(SB, dtype=jnp.int32), seg))
             return (last_pos[None], hist[None], head_pos[None])
 
+        from pluss.ops import pallas_events
+
+        # suppressing(): no pallas_call replication rule under shard_map
         fn = jax.jit(
-            compat.shard_map(body, mesh=mesh,
+            compat.shard_map(pallas_events.suppressing(body), mesh=mesh,
                              in_specs=(P(), P("d"), P("d"), P("d"), P("d")),
                              out_specs=(P("d"), P("d"), P("d"))),
             donate_argnums=donate,

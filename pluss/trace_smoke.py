@@ -16,7 +16,11 @@ staged-ahead h2d → segmented kernel — instead of leaving it to the
    uninterrupted replay;
 4. the legacy per-window scan (``segmented=False``) under the
    single-reader, fixed-width-pack feed — one step that A/Bs the kernel,
-   the pool, AND the wire against step 1.
+   the pool, AND the wire against step 1;
+5. the fused Pallas pipeline (r19) in interpreter mode — the fused event
+   histogram AND the Pallas d24v decode forced on via their env knobs —
+   bit-identical to step 1's XLA path (kernel promotion must never move
+   a histogram bit).
 
 Run directly (``python -m pluss.trace_smoke``) or through the pytest
 wrapper in tests/test_trace.py.  Pins the CPU backend unless
@@ -122,9 +126,43 @@ def main(n_refs: int = 1 << 20, window: int = 1 << 14,
                                       "legacy scan/serial feed != segmented"
                                       "/parallel d24v")
 
+        # fused Pallas pipeline (interpreter mode on CPU): force both
+        # kernels on through the env knobs and A/B against step 1.  A
+        # lowering failure would degrade to the XLA path (loud, counted)
+        # and the histogram check still passes — the gate additionally
+        # pins that the probes themselves succeed on this build.
+        from pluss.ops import pallas_decode, pallas_events
+        from pluss.utils import envknob
+
+        saved = {k: os.environ.get(k)
+                 for k in ("PLUSS_PALLAS_EVENTS", "PLUSS_PALLAS_DECODE")}
+        os.environ["PLUSS_PALLAS_EVENTS"] = "1"
+        os.environ["PLUSS_PALLAS_DECODE"] = "1"
+        envknob._parse_bool.cache_clear()
+        pallas_events.reset_probe()
+        pallas_decode.reset_probe()
+        try:
+            assert pallas_events.probe_ok(), \
+                "fused event-histogram kernel failed its compile probe"
+            assert pallas_decode.probe_ok(), \
+                "Pallas d24v decode kernel failed its compile probe"
+            fused = trace.replay_file(path, window=window,
+                                      batch_windows=batch_windows,
+                                      segmented=True, wire="d24v",
+                                      feed_workers=2)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            envknob._parse_bool.cache_clear()
+        np.testing.assert_array_equal(fused.hist, ref.hist,
+                                      "fused Pallas pipeline != XLA path")
+
     print(f"trace smoke OK: {n_refs} refs over {ref.n_lines} line slots; "
           "parallel-d24v stream == resident(u24) == resident(d24v) == "
-          "resumed == legacy-serial-pack", file=sys.stderr)
+          "resumed == legacy-serial-pack == fused-pallas", file=sys.stderr)
     return 0
 
 

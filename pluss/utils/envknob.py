@@ -33,6 +33,35 @@ def env_choice(name: str, default: str, choices: tuple[str, ...]) -> str:
                          tuple(choices))
 
 
+def env_bool(name: str, default: bool | None = None) -> bool | None:
+    """Boolean env knob.  ``default`` may be None (tri-state): an UNSET
+    knob returns it unchanged, so call sites can distinguish "operator
+    said nothing" (consult the autotuned/back-end default) from an
+    explicit 0/1.  ``PLUSS_X=0`` really means off — the historical
+    ``bool(os.environ.get(...))`` pattern treated it as on, which is
+    exactly the bug this parser exists to retire."""
+    return _parse_bool(name, os.environ.get(name, ""), default)
+
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+@functools.lru_cache(maxsize=64)
+def _parse_bool(name: str, raw: str, default: bool | None) -> bool | None:
+    v = raw.strip().lower()
+    if not v:
+        return default
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    print(f"pluss: ignoring malformed {name}={raw!r} (want one of "
+          f"{', '.join(_TRUE + _FALSE)}); using the default {default}",
+          file=sys.stderr)
+    return default
+
+
 def env_int_list(name: str, default: tuple[int, ...],
                  minimum: int = 1) -> tuple[int, ...]:
     """Comma-separated ascending int list (e.g. PLUSS_CACHE_LEVELS): any

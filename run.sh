@@ -100,7 +100,10 @@ JAX_PLATFORMS=cpu python -m pluss.cli import \
 # kernel/serial-feed/plain-pack A/B on a ~1e6-ref synthetic trace, pinned
 # to the CPU backend (~10 s).  The replay pipeline — worker pool,
 # compactor turnstile, device-side wire decode, staged-ahead h2d — is
-# exercised on every PR, not just in the budget-gated bench.  Runs with
+# exercised on every PR, not just in the budget-gated bench.  Since r19
+# the smoke's last phase forces the fused Pallas pipeline (event
+# histogram + d24v decode, interpreter mode on CPU) and pins it
+# bit-identical to the XLA path — the kernel-promotion gate.  Runs with
 # the telemetry sink ARMED, and the emitted event stream must pass the
 # schema check (`pluss stats --check`) — an observability regression
 # (malformed records, a broken sink) gates the PR like any other.
@@ -109,6 +112,31 @@ JAX_PLATFORMS=cpu PLUSS_TELEMETRY="$PLUSS_OBS_LOG" \
   python -m pluss.trace_smoke 1>&2
 python -m pluss.cli stats "$PLUSS_OBS_LOG" --check 1>&2
 rm -f "$PLUSS_OBS_LOG"
+
+# autotune sidecar gate (tier-1, r19): a short forced calibration into a
+# throwaway plan-cache dir must persist a geometry sidecar that (a)
+# passes `pluss autotune --dry-run` validation and (b) short-circuits a
+# second `pluss autotune` with ZERO re-calibration (the persist→consult
+# round trip, witnessed by the autotune.hit counter in its telemetry).
+PLUSS_AT_DIR=$(mktemp -d /tmp/pluss_at_XXXX)
+PLUSS_AT_LOG=$(mktemp /tmp/pluss_at_XXXX.jsonl)
+JAX_PLATFORMS=cpu PLUSS_PLAN_CACHE_DIR="$PLUSS_AT_DIR" \
+  python -m pluss.cli autotune --refs 60000 --cpu 1>&2
+JAX_PLATFORMS=cpu PLUSS_PLAN_CACHE_DIR="$PLUSS_AT_DIR" \
+  python -m pluss.cli autotune --dry-run 1>&2
+JAX_PLATFORMS=cpu PLUSS_PLAN_CACHE_DIR="$PLUSS_AT_DIR" \
+  PLUSS_TELEMETRY="$PLUSS_AT_LOG" \
+  python -m pluss.cli autotune --cpu 1>&2
+python -c "import json, sys; \
+c = {r['name']: r.get('value', 0) \
+     for r in map(json.loads, open(sys.argv[1])) \
+     if r.get('ev') == 'counter'}; \
+assert c.get('autotune.hit', 0) >= 1, f'no sidecar consult: {c}'; \
+assert not c.get('autotune.probe'), f'hit still recalibrated: {c}'; \
+print('autotune round-trip: hit=%d, zero re-calibration' \
+    % c['autotune.hit'])" "$PLUSS_AT_LOG" 1>&2
+python -m pluss.cli stats "$PLUSS_AT_LOG" --check 1>&2
+rm -rf "$PLUSS_AT_DIR" "$PLUSS_AT_LOG"
 
 # trace residency smoke (tier-1, r13): replay the same trace twice in one
 # process with the HBM residency store armed — the first run streams and

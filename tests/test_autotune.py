@@ -1,0 +1,269 @@
+"""Persisted batch-geometry autotuner (r19): sidecar round-trip, salt
+invalidation, corrupt quarantine, dry-run exit codes, forced-probe
+fallback during calibration, and the README / stats-block sync.
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from pluss import autotune
+
+
+@pytest.fixture
+def plan_cache(tmp_path, monkeypatch):
+    """Opt back into the plan cache (conftest disables it) with a private
+    root, and forget memoized sidecar loads on both sides."""
+    monkeypatch.delenv("PLUSS_NO_PLAN_CACHE", raising=False)
+    monkeypatch.setenv("PLUSS_PLAN_CACHE_DIR", str(tmp_path))
+    autotune.invalidate()
+    yield tmp_path
+    autotune.invalidate()
+
+
+@pytest.fixture
+def counters(tmp_path):
+    """An active telemetry session; yields a snapshot callable."""
+    from pluss import obs
+
+    obs.shutdown()
+    obs.configure(str(tmp_path / "telemetry.jsonl"))
+    yield obs.counters
+    obs.shutdown()
+
+
+def _valid_doc():
+    from pluss import plancache
+
+    return {
+        "version": 1,
+        "salt": plancache.runtime_salt(),
+        "geometry": {"window": 4096, "batch_windows": 2, "stage_depth": 2,
+                     "queue_depth": 2, "feed_workers": 1, "wire": "pack",
+                     "pallas": False},
+        "refs_per_sec": 1234.5,
+        "calibration": {"n_refs": 4096, "points": 1, "elapsed_s": 0.1},
+    }
+
+
+def test_sidecar_roundtrip(plan_cache):
+    """_save → consult round-trips every geometry field; the sidecar
+    lands under the plan-cache root, salt-keyed."""
+    path = autotune._save(_valid_doc())
+    assert path is not None and os.path.exists(path)
+    assert os.path.dirname(path) == str(plan_cache)
+    assert os.path.basename(path).startswith("autotune-")
+    geo = _valid_doc()["geometry"]
+    for k, v in geo.items():
+        assert autotune.consult(k) == v
+    assert autotune.tuned_geometry() == geo
+    assert autotune.consult("no_such_field") is None
+
+
+def test_no_plan_cache_means_no_sidecar(monkeypatch):
+    monkeypatch.setenv("PLUSS_NO_PLAN_CACHE", "1")
+    autotune.invalidate()
+    assert autotune.sidecar_path() is None
+    assert autotune.consult("window") is None
+    assert autotune._save(_valid_doc()) is None
+
+
+def test_hit_counted_once_per_process(plan_cache, counters):
+    """Consults are memoized: many lookups, ONE disk read, ONE
+    autotune.hit — the witness run.sh checks for zero re-calibration."""
+    autotune._save(_valid_doc())
+    autotune.invalidate()
+    for _ in range(5):
+        assert autotune.consult("window") == 4096
+    assert counters().get("autotune.hit") == 1
+    assert not counters().get("autotune.stale")
+
+
+def test_salt_mismatch_is_a_stale_miss(plan_cache, counters, capsys):
+    """A sidecar calibrated on a different runtime is ignored (counted
+    stale, one stderr notice) but NOT quarantined — it may be valid for
+    the runtime that wrote it."""
+    doc = _valid_doc()
+    doc["salt"] = "jax=0.0.0/other/other/nbins=1"
+    path = autotune.sidecar_path()
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    assert autotune.consult("window") is None
+    assert autotune.tuned_geometry() is None
+    assert counters().get("autotune.stale") == 1
+    assert "different runtime" in capsys.readouterr().err
+    assert os.path.exists(path)          # left in place, not quarantined
+
+
+def test_corrupt_sidecar_quarantined(plan_cache, counters, capsys):
+    """Unparseable bytes: counted stale, renamed to .corrupt, consult
+    returns None — never a crash."""
+    path = autotune.sidecar_path()
+    with open(path, "wb") as f:
+        f.write(b"\x00not json{{{")
+    assert autotune.consult("window") is None
+    assert counters().get("autotune.stale") == 1
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+    assert "recalibrate" in capsys.readouterr().err
+
+
+def test_invalid_geometry_field_quarantined(plan_cache, counters):
+    """Schema validation bites: a parseable doc with an out-of-domain
+    field (wire not in pack/d24v) is quarantined like corrupt bytes."""
+    doc = _valid_doc()
+    doc["geometry"]["wire"] = "carrier-pigeon"
+    path = autotune.sidecar_path()
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    assert autotune.consult("wire") is None
+    assert counters().get("autotune.stale") == 1
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_consult_disabled_by_env(plan_cache, monkeypatch):
+    autotune._save(_valid_doc())
+    monkeypatch.setenv("PLUSS_AUTOTUNE", "0")
+    autotune.invalidate()
+    assert autotune.consult("window") is None
+    monkeypatch.delenv("PLUSS_AUTOTUNE")
+    autotune.invalidate()
+    assert autotune.consult("window") == 4096
+
+
+def test_dry_run_exit_codes(plan_cache, monkeypatch):
+    """0 for 'no sidecar yet' and for a valid one; 1 only when a file
+    exists but fails validation (the run.sh gate's broken-artifact
+    signal)."""
+    buf = io.StringIO()
+    assert autotune.dry_run(buf) == 0
+    assert "no sidecar yet" in buf.getvalue()
+
+    autotune._save(_valid_doc())
+    buf = io.StringIO()
+    assert autotune.dry_run(buf) == 0
+    out = buf.getvalue()
+    assert "valid sidecar" in out and "window" in out
+
+    path = autotune.sidecar_path()
+    with open(path, "w") as f:
+        f.write("not json")
+    buf = io.StringIO()
+    assert autotune.dry_run(buf) == 1
+    assert "failed validation" in buf.getvalue()
+
+    monkeypatch.setenv("PLUSS_NO_PLAN_CACHE", "1")
+    buf = io.StringIO()
+    assert autotune.dry_run(buf) == 0
+    assert "plan cache disabled" in buf.getvalue()
+
+
+def test_calibrate_short_circuits_on_valid_sidecar(plan_cache, monkeypatch):
+    """An existing valid sidecar means ZERO re-calibration: _time_point
+    must never run without --force."""
+    autotune._save(_valid_doc())
+    autotune.invalidate()
+
+    def boom(*a, **k):
+        raise AssertionError("calibration ran despite a valid sidecar")
+
+    monkeypatch.setattr(autotune, "_time_point", boom)
+    buf = io.StringIO()
+    doc = autotune.calibrate(out=buf)
+    assert doc["geometry"] == _valid_doc()["geometry"]
+    assert "already persisted" in buf.getvalue()
+
+
+def test_calibrate_persists_winner(plan_cache, monkeypatch, counters):
+    """A short real calibration (one candidate, two tiny replays)
+    persists a schema-valid winner that the next consult serves."""
+    monkeypatch.setattr(autotune, "_candidates",
+                        lambda base: [dict(base, pallas=False)])
+    buf = io.StringIO()
+    doc = autotune.calibrate(n_refs=16384, out=buf)
+    assert doc["version"] == 1
+    for k, ok in autotune._FIELDS.items():
+        assert ok(doc["geometry"][k]), (k, doc["geometry"][k])
+    assert counters().get("autotune.probe") == 1
+    assert os.path.exists(autotune.sidecar_path())
+    autotune.invalidate()
+    assert autotune.tuned_geometry() == doc["geometry"]
+    # the persisted winner now short-circuits a second calibrate
+    buf = io.StringIO()
+    again = autotune.calibrate(out=buf)
+    assert again["geometry"] == doc["geometry"]
+    assert "already persisted" in buf.getvalue()
+
+
+def test_calibrate_forced_probe_falls_back_to_xla(plan_cache, monkeypatch,
+                                                  counters, capsys):
+    """A pallas=True calibration point on a runtime whose Pallas probe
+    fails must degrade to the XLA path (loud, counted) and still produce
+    a winner — calibration can never crash on a broken lowering."""
+    from pluss.ops import pallas_decode, pallas_events
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic lowering failure")
+
+    monkeypatch.setattr(pallas_events, "_probe_impl", boom)
+    monkeypatch.setattr(pallas_decode, "_probe_impl", boom)
+    pallas_events.reset_probe()
+    pallas_decode.reset_probe()
+    monkeypatch.setattr(autotune, "_candidates",
+                        lambda base: [dict(base, pallas=True)])
+    try:
+        doc = autotune.calibrate(n_refs=16384, force=True,
+                                 out=io.StringIO())
+    finally:
+        monkeypatch.undo()
+        pallas_events.reset_probe()
+        pallas_decode.reset_probe()
+    assert doc["geometry"]["pallas"] is True     # the knob, as requested
+    assert counters().get("pallas.fallback", 0) >= 1
+    assert "using the XLA path" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# stats block + README sync
+
+
+def test_stats_autotune_breakdown_render():
+    from pluss.obs.stats import autotune_breakdown
+
+    assert autotune_breakdown({}, {}) == []
+    counters = {"pallas.probe": 2.0, "pallas.fallback": 0.0,
+                "autotune.probe": 9.0, "autotune.hit": 1.0,
+                "autotune.stale": 0.0}
+    lines = autotune_breakdown(counters, {})
+    assert lines[0] == "kernels & autotune:"
+    text = "\n".join(lines)
+    assert "pallas probes / fallbacks" in text and "2 / 0" in text
+    assert "DISABLED" not in text
+    assert "geometry hits / stale" in text and "1 / 0" in text
+    assert "calibration points timed" in text and "9" in text
+
+    broken = autotune_breakdown({"pallas.probe": 1.0,
+                                 "pallas.fallback": 1.0}, {})
+    assert "fused kernels DISABLED, XLA path" in "\n".join(broken)
+
+
+def test_readme_documents_kernels_and_autotune():
+    """README's 'TPU-native kernels & autotuning' section must name every
+    knob and counter this subsystem emits — the doc is the operator's
+    only map."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(here, "README.md")) as f:
+        readme = f.read()
+    start = readme.index("## TPU-native kernels & autotuning")
+    end = readme.index("\n## ", start + 1)
+    section = readme[start:end]
+    for knob in ("PLUSS_PALLAS_EVENTS", "PLUSS_PALLAS_DECODE",
+                 "PLUSS_AUTOTUNE"):
+        assert knob in section, knob
+    for counter in ("pallas.probe", "pallas.fallback", "autotune.hit",
+                    "autotune.stale"):
+        assert counter in section, counter
+    assert "kernels & autotune:" in section
+    assert "pluss autotune" in section

@@ -1,19 +1,39 @@
-"""Pallas fused event histogram (pluss.ops.pallas_events) vs the XLA path.
+"""Fused Pallas kernels (pallas_events + pallas_decode) vs the XLA path.
 
-On the CPU mesh the kernel runs in interpret mode — same code the TPU
-compiles.  The kernel is strictly flag-gated; these tests call it directly
-and through the engine flag."""
+On the CPU mesh the kernels run in interpret mode — same code the TPU
+compiles.  Since r19 the fused event histogram is the promoted post-sort
+default (accelerators; probe-guarded) and the d24v decode has a Pallas
+twin, so the equivalence matrix here is the promotion gate: fused vs XLA
+bit-identity across wire formats, ragged tails, cross-batch carries, and
+fault-interrupted resume splits.
+"""
+
+import os
 
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
-from pluss import engine
+from pluss import engine, trace
 from pluss.config import SamplerConfig
 from pluss.models import gemm, syrk_triangular
-from pluss.ops import pallas_events
+from pluss.ops import pallas_decode, pallas_events, wirecodec
 from pluss.ops.reuse import carried_events, event_histogram, sort_stream
+
+
+@pytest.fixture
+def fused_on(monkeypatch):
+    """Force both fused kernels on (interpret mode on CPU), restoring the
+    probe/memo caches on the way out so later tests see a clean slate."""
+    monkeypatch.setenv("PLUSS_PALLAS_EVENTS", "1")
+    monkeypatch.setenv("PLUSS_PALLAS_DECODE", "1")
+    pallas_events.reset_probe()
+    pallas_decode.reset_probe()
+    yield
+    pallas_events.reset_probe()
+    pallas_decode.reset_probe()
 
 
 @pytest.mark.parametrize("seed,n,n_lines", [(0, 4096, 64), (1, 50000, 300)])
@@ -60,3 +80,259 @@ def test_engine_flag_matches_default_gemm(monkeypatch):
     engine.compiled.cache_clear()
     np.testing.assert_array_equal(a.noshare_dense, b.noshare_dense)
     assert a.share_list() == b.share_list()
+
+
+# ---------------------------------------------------------------------------
+# envknob gating (r19 satellite: PLUSS_PALLAS_EVENTS=0 must mean OFF)
+
+
+def test_env_bool_tristate(capsys):
+    from pluss.utils.envknob import env_bool
+
+    for raw, want in (("1", True), ("true", True), ("YES", True),
+                      ("on", True), ("0", False), ("false", False),
+                      ("No", False), ("off", False), ("", None)):
+        os.environ["PLUSS_TEST_BOOL"] = raw
+        try:
+            assert env_bool("PLUSS_TEST_BOOL", None) is want, raw
+        finally:
+            del os.environ["PLUSS_TEST_BOOL"]
+    assert env_bool("PLUSS_TEST_BOOL_UNSET", None) is None
+    assert env_bool("PLUSS_TEST_BOOL_UNSET", True) is True
+    os.environ["PLUSS_TEST_BOOL_BAD"] = "bananas"
+    try:
+        assert env_bool("PLUSS_TEST_BOOL_BAD", False) is False
+    finally:
+        del os.environ["PLUSS_TEST_BOOL_BAD"]
+    assert "malformed" in capsys.readouterr().err
+
+
+def test_env_zero_really_disables(monkeypatch):
+    """The pre-r19 bug: enabled() tested presence, so =0 ENABLED the
+    kernel.  Now =0 must resolve to off on any backend."""
+    monkeypatch.setenv("PLUSS_PALLAS_EVENTS", "0")
+    monkeypatch.setenv("PLUSS_PALLAS_DECODE", "0")
+    assert pallas_events.enabled() is False
+    assert pallas_decode.enabled() is False
+    monkeypatch.setenv("PLUSS_PALLAS_EVENTS", "1")
+    monkeypatch.setenv("PLUSS_PALLAS_DECODE", "1")
+    assert pallas_events.enabled() is True
+    assert pallas_decode.enabled() is True
+
+
+def test_cpu_default_is_off(monkeypatch):
+    """Unset env + no tuned geometry -> the CPU backend stays on the XLA
+    path (the interpreter kernel exists for tests, not production)."""
+    monkeypatch.delenv("PLUSS_PALLAS_EVENTS", raising=False)
+    monkeypatch.delenv("PLUSS_PALLAS_DECODE", raising=False)
+    monkeypatch.setenv("PLUSS_AUTOTUNE", "0")   # no sidecar consult
+    assert jax.default_backend() == "cpu"
+    assert pallas_events.enabled() is False
+    assert pallas_decode.enabled() is False
+
+
+def test_probe_failure_degrades_loudly(monkeypatch, capsys):
+    """A lowering/compile failure must count pallas.fallback, print one
+    stderr line, and resolve enabled() False even under env=1 — the
+    promotion can never crash a replay."""
+    from pluss import obs
+
+    monkeypatch.setenv("PLUSS_PALLAS_EVENTS", "1")
+    pallas_events.reset_probe()
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic lowering failure")
+
+    monkeypatch.setattr(pallas_events, "_probe_impl", boom)
+    obs.shutdown()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        obs.configure(os.path.join(td, "ev.jsonl"))
+        try:
+            assert pallas_events.enabled() is False
+            c = obs.counters()
+        finally:
+            obs.shutdown()
+    assert c.get("pallas.probe", 0) >= 1
+    assert c.get("pallas.fallback", 0) >= 1
+    err = capsys.readouterr().err
+    assert "using the XLA path" in err
+    pallas_events.reset_probe()
+    # a clean probe afterwards recovers (the verdict was memoized, not
+    # sticky beyond reset)
+    monkeypatch.undo()
+    pallas_events.reset_probe()
+    assert pallas_events.probe_ok() is True
+
+
+def test_memo_key_includes_device_kind():
+    """r19 satellite: the kernel memo must key on the device kind so a
+    TPU-generation switch under one backend string rebuilds."""
+    pallas_events.reset_probe()
+    a = pallas_events._event_hist_fn(pallas_events.BLOCK, "int32",
+                                     "cpu", "kind-A")
+    b = pallas_events._event_hist_fn(pallas_events.BLOCK, "int32",
+                                     "cpu", "kind-B")
+    assert a is not b
+    assert pallas_events._event_hist_fn(
+        pallas_events.BLOCK, "int32", "cpu", "kind-A") is a
+    pallas_events.reset_probe()
+
+
+def test_padded_n_quantized():
+    """r19 satellite: ragged windows land on a bounded set of padded
+    lengths (the wirecodec pad_len trick) instead of one retrace per
+    distinct length."""
+    B = pallas_events.BLOCK
+    assert pallas_events._padded_n(1) == B
+    assert pallas_events._padded_n(B) == B
+    assert pallas_events._padded_n(B + 1) == 2 * B
+    lens = {pallas_events._padded_n(n)
+            for n in range(1, 2_000_000, 4093)}
+    for n in range(1, 3_000_000, 9973):
+        p = pallas_events._padded_n(n)
+        assert p >= n and p % B == 0
+    # a 2e6 range of raw lengths collapses to a bounded shape set:
+    # exact block counts through 8 blocks, then eighth-octave rounding —
+    # at most 8 shapes per octave, ~6 octaves at 2e6 refs
+    assert len(lens) <= 56, sorted(lens)
+
+
+# ---------------------------------------------------------------------------
+# Pallas d24v decode vs the XLA wirecodec decode
+
+
+def _id_patterns():
+    rng = np.random.default_rng(11)
+    B = wirecodec.BLOCK
+    return {
+        "sequential": np.arange(4 * B, dtype=np.int32) % (1 << 20),
+        "random24": rng.integers(0, 1 << 24, 3 * B).astype(np.int32),
+        "mix": np.concatenate([
+            np.arange(B, dtype=np.int32),                 # delta, narrow
+            rng.integers(0, 1 << 24, B).astype(np.int32),  # raw
+            np.full(B, 7, np.int32),                       # delta, k=1
+            rng.integers(0, 1 << 10, B // 2).astype(np.int32)]),  # ragged
+        "zeros": np.zeros(2 * B, np.int32),
+        "tiny_ragged": np.arange(37, dtype=np.int32) * 5,
+        "strided": (np.arange(2 * B, dtype=np.int32) * 4097) % (1 << 24),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_id_patterns()))
+def test_decode_d24v_bit_identical(name):
+    ids = _id_patterns()[name]
+    payload, wm = wirecodec.encode_d24v(ids)
+    ref = np.asarray(wirecodec.decode_d24v(jnp.asarray(payload),
+                                           jnp.asarray(wm)))
+    # the jit executes the interpret-mode pallas_call (no eager eval rule)
+    got = np.asarray(jax.jit(pallas_decode.decode_d24v)(
+        jnp.asarray(payload), jnp.asarray(wm)))
+    np.testing.assert_array_equal(got, ref, err_msg=name)
+    np.testing.assert_array_equal(got[:len(ids)], ids, err_msg=name)
+
+
+def test_decode_probe_ok_on_cpu():
+    pallas_decode.reset_probe()
+    assert pallas_decode.probe_ok() is True
+    pallas_decode.reset_probe()
+
+
+# ---------------------------------------------------------------------------
+# full-pipeline equivalence matrix: fused vs XLA through replay_file
+
+
+def _write_trace(path, n_refs, seed=5):
+    rng = np.random.default_rng(seed)
+    lines = np.concatenate([
+        rng.integers(0, 1 << 10, n_refs // 2, dtype=np.int64),
+        rng.integers(0, 1 << 15, n_refs - n_refs // 2, dtype=np.int64)])
+    rng.shuffle(lines)
+    (lines.astype(np.uint64) << np.uint64(6)).astype("<u8").tofile(path)
+
+
+#: n_refs = 3 batches of (2 windows x 4096) + a ragged 1500-ref tail:
+#: cross-batch carries AND a non-BLOCK-multiple final window
+_N_REFS = 3 * 2 * 4096 + 1500
+_GEO = dict(window=4096, batch_windows=2, segmented=True)
+
+
+@pytest.mark.parametrize("wire", ["pack", "d24v"])
+def test_replay_fused_matches_xla(tmp_path, monkeypatch, fused_on, wire):
+    path = str(tmp_path / "t.bin")
+    _write_trace(path, _N_REFS)
+    fused = trace.replay_file(path, wire=wire, **_GEO)
+    monkeypatch.setenv("PLUSS_PALLAS_EVENTS", "0")
+    monkeypatch.setenv("PLUSS_PALLAS_DECODE", "0")
+    ref = trace.replay_file(path, wire=wire, **_GEO)
+    assert fused.total_count == ref.total_count == _N_REFS
+    np.testing.assert_array_equal(fused.hist, ref.hist)
+
+
+@pytest.mark.parametrize("wire", ["pack", "d24v"])
+def test_replay_fused_resume_split(tmp_path, monkeypatch, fused_on, wire):
+    """Fault-interrupted checkpoint --resume under the fused kernels must
+    reproduce the uninterrupted XLA histogram bit-exactly — the carry
+    state crosses the checkpoint boundary through the same last_pos
+    contract either way."""
+    from pluss.resilience import faults
+    from pluss.resilience.errors import DataLoss
+
+    path = str(tmp_path / "t.bin")
+    _write_trace(path, _N_REFS)
+    monkeypatch.setenv("PLUSS_PALLAS_EVENTS", "0")
+    monkeypatch.setenv("PLUSS_PALLAS_DECODE", "0")
+    ref = trace.replay_file(path, wire=wire, **_GEO)
+    monkeypatch.setenv("PLUSS_PALLAS_EVENTS", "1")
+    monkeypatch.setenv("PLUSS_PALLAS_DECODE", "1")
+    ckpt = str(tmp_path / "t.ckpt.npz")
+    faults.install(faults.FaultPlan.parse("trace_loss@2"))
+    try:
+        with pytest.raises(DataLoss):
+            trace.replay_file(path, wire=wire, checkpoint_path=ckpt,
+                              checkpoint_every=1, **_GEO)
+    finally:
+        faults.install(None)
+    assert os.path.exists(ckpt)
+    resumed = trace.replay_file(path, wire=wire, checkpoint_path=ckpt,
+                                resume=True, **_GEO)
+    assert resumed.total_count == ref.total_count == _N_REFS
+    np.testing.assert_array_equal(resumed.hist, ref.hist)
+
+
+def test_shard_dispatch_fused_matches_xla(tmp_path, monkeypatch, fused_on):
+    """Both sharded dispatch modes consume the fused post-sort consumer
+    through ops.reuse.event_histogram — bit-identical to the XLA path."""
+    path = str(tmp_path / "t.bin")
+    _write_trace(path, _N_REFS)
+    out = {}
+    for mode in ("steal", "static"):
+        monkeypatch.setenv("PLUSS_PALLAS_EVENTS", "1")
+        monkeypatch.setenv("PLUSS_PALLAS_DECODE", "1")
+        fused = trace.shard_replay_file(path, window=4096,
+                                        batch_windows=2, dispatch=mode)
+        monkeypatch.setenv("PLUSS_PALLAS_EVENTS", "0")
+        monkeypatch.setenv("PLUSS_PALLAS_DECODE", "0")
+        ref = trace.shard_replay_file(path, window=4096,
+                                      batch_windows=2, dispatch=mode)
+        np.testing.assert_array_equal(fused.hist, ref.hist,
+                                      err_msg=f"dispatch={mode}")
+        out[mode] = np.asarray(ref.hist)
+    np.testing.assert_array_equal(out["steal"], out["static"])
+
+
+def test_fused_vmap_batch_matches_xla():
+    """The engine's thread-vmap wraps the fused histogram in a batch
+    dimension; the interpret-mode kernel must batch bit-identically."""
+    rng = np.random.default_rng(3)
+    n = 4096
+    ev = {
+        "reuse": jnp.asarray(rng.integers(1, 1 << 16, (4, n)), jnp.int32),
+        "is_evt": jnp.asarray(rng.random((4, n)) < 0.6),
+        "share": jnp.asarray(rng.random((4, n)) < 0.1),
+        "cold": jnp.asarray(rng.random((4, n)) < 0.2),
+    }
+    want = np.asarray(jax.vmap(event_histogram)(ev))
+    got = np.asarray(jax.vmap(pallas_events.fused_event_histogram)(ev))
+    np.testing.assert_array_equal(got, want)
