@@ -219,6 +219,59 @@ def round_keep(v: float | None, nd: int) -> float | None:
     return v if (r == 0.0 and v != 0.0) else r
 
 
+#: metric-record schema: v2 adds the per-record ``schema`` + ``t_wall``
+#: stamps (r20) so a record line is attributable to a run without the
+#: surrounding file — consumers reading BENCH_r0x tails can dedup and
+#: order by wall clock instead of by position
+BENCH_SCHEMA = 2
+#: every record emitted this run, in order — the self-recorded round
+#: file (``BENCH_r06.json`` on) is written from this at exit
+_RECORDS: list[dict] = []
+
+
+def emit_record(rec: dict) -> None:
+    """The single stdout sink for metric records: stamps the schema
+    version and a wall-clock timestamp on EVERY record, remembers it for
+    the self-recorded round file, and prints the JSON line."""
+    rec.setdefault("schema", BENCH_SCHEMA)
+    rec.setdefault("t_wall", round(time.time(), 3))
+    _RECORDS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def next_round_n() -> int:
+    """The round number to self-record under: one past the highest
+    existing BENCH_r<N>.json (the driver-written trajectory ends at
+    r05, so a fresh checkout records r06)."""
+    import glob
+
+    seen = [int(m.group(1)) for f in glob.glob("BENCH_r*.json")
+            if (m := re.match(r"BENCH_r(\d+)\.json$", os.path.basename(f)))]
+    return max(seen, default=5) + 1
+
+
+def write_round_record(n: int, rc: int) -> None:
+    """Self-record the round in the driver's BENCH_r0x shape ({n, cmd,
+    rc, tail, parsed}): the trajectory stopped at BENCH_r05 when the
+    driver quit writing it, so from r06 on the bench writes its own."""
+    path = f"BENCH_r{n:02d}.json"
+    lines = [json.dumps(r) for r in _RECORDS]
+    parsed = None
+    for r in reversed(_RECORDS):
+        if "metric" in r:
+            parsed = {k: r.get(k) for k in ("metric", "value", "unit",
+                                            "vs_baseline")}
+            break
+    try:
+        with open(path, "w") as f:
+            json.dump({"n": n, "cmd": "python " + " ".join(sys.argv),
+                       "rc": rc, "tail": "\n".join(lines)[-1600:],
+                       "parsed": parsed}, f, indent=1)
+        log(f"bench: round record -> {path} ({len(lines)} record(s))")
+    except OSError as e:
+        log(f"bench: cannot write {path}: {e}")
+
+
 def emit(metric: str, refs: int, best_s: float, base_s: float | None,
          path: str = "", degradations: tuple = (), **extra) -> None:
     """One JSON metric line.  ``path`` names the code path measured
@@ -235,7 +288,7 @@ def emit(metric: str, refs: int, best_s: float, base_s: float | None,
     log(f"bench: {metric} best {refs_per_sec:.3e} refs/s"
         + (f", native {base_s:.3f} s/run -> speedup {vs:.2f}x" if vs else "")
         + (f" [degraded: {','.join(degradations)}]" if degradations else ""))
-    print(json.dumps({
+    emit_record({
         "metric": metric,
         "value": round_keep(refs_per_sec, 1),
         "unit": "refs/s",
@@ -243,7 +296,7 @@ def emit(metric: str, refs: int, best_s: float, base_s: float | None,
         "path": path,
         "degradations": list(degradations),
         **extra,
-    }), flush=True)
+    })
 
 
 def analysis_fields(spec) -> dict:
@@ -701,7 +754,7 @@ def bench_autotune() -> None:
     cal_s = time.perf_counter() - t0
     log(f"bench: autotune calibrated in {cal_s:.1f}s -> "
         f"{doc['geometry']} ({doc['refs_per_sec']:.3e} refs/s)")
-    print(json.dumps({
+    emit_record({
         "metric": "autotune_calibration_s",
         "value": round_keep(cal_s, 3),
         "unit": "s",
@@ -710,7 +763,7 @@ def bench_autotune() -> None:
         "degradations": [],
         "geometry": doc["geometry"],
         "winner_refs_per_sec": round_keep(doc["refs_per_sec"], 1),
-    }), flush=True)
+    })
 
 
 def bench_multichip(trace_refs: int) -> None:
@@ -754,8 +807,12 @@ def bench_multichip(trace_refs: int) -> None:
     for ln in out.stderr.splitlines():
         if ln.strip():
             log(ln)
-    for ln in out.stdout.splitlines():   # already bench-schema JSON lines
-        if ln.strip():
+    for ln in out.stdout.splitlines():   # bench-schema JSON metric lines
+        if not ln.strip():
+            continue
+        try:
+            emit_record(json.loads(ln))   # re-stamp: child lines carry no
+        except ValueError:                # schema/t_wall of their own
             print(ln, flush=True)
 
 
@@ -829,7 +886,7 @@ def bench_serve(n_requests: int = 48) -> None:
             ("serve_p50_ms", "ms", u[0] / b[0] if b[0] else None),
             ("serve_p99_ms", "ms", u[1] / b[1] if b[1] else None),
             ("serve_reqs_per_sec", "req/s", b[2] / u[2] if u[2] else None))):
-        print(json.dumps({
+        emit_record({
             "metric": name,
             "value": round_keep(b[i], 3),
             "unit": unit,
@@ -838,7 +895,7 @@ def bench_serve(n_requests: int = 48) -> None:
             "degradations": [],
             "unbatched": round_keep(u[i], 3),
             "requests": n_requests,
-        }), flush=True)
+        })
 
 
 #: child of the cold/warm A/B: one fresh process, one full run, counters
@@ -909,7 +966,7 @@ def bench_warmstart(n: int, cpu: bool) -> None:
         f"{warm['first_dispatch_s']:.2f}s (compile {warm['compile_s']:.2f}s,"
         f" {int(warm['aot_hit'])} sidecar hit(s)) -> {ratio:.2f}x")
     for tag, rec, vs in (("cold", cold, None), ("warm", warm, ratio)):
-        print(json.dumps({
+        emit_record({
             "metric": f"gemm{n}_{tag}_start_s",
             "value": round_keep(rec["first_dispatch_s"], 3),
             "unit": "s",
@@ -921,7 +978,7 @@ def bench_warmstart(n: int, cpu: bool) -> None:
             "aot_hit": int(rec["aot_hit"]),
             "aot_load_fail": int(rec["aot_load_fail"]),
             "refs": rec["refs"],
-        }), flush=True)
+        })
 
 
 def bench_serve_warm(n: int = 64) -> None:
@@ -957,7 +1014,7 @@ def bench_serve_warm(n: int = 64) -> None:
     warmed = bool(obs.counters().get("serve.warmed", 0))
     log(f"bench: serve --warm first request {ms:.1f} ms "
         f"(warmed={warmed})")
-    print(json.dumps({
+    emit_record({
         "metric": "serve_warm_first_request_ms",
         "value": round_keep(ms, 3),
         "unit": "ms",
@@ -965,7 +1022,7 @@ def bench_serve_warm(n: int = 64) -> None:
         "path": "serve(--warm gemm)",
         "degradations": [],
         "warmed": warmed,
-    }), flush=True)
+    })
 
 
 def bench_serve_trace_warm(n_refs: int = 1 << 22,
@@ -1013,7 +1070,7 @@ def bench_serve_trace_warm(n_refs: int = 1 << 22,
                - c0.get("residency.hit", 0))
     log(f"bench: serve trace cold {cold:.1f} ms, warm p50 {p50:.1f} ms "
         f"over {len(lat)} repeats ({hits} residency hits)")
-    print(json.dumps({
+    emit_record({
         "metric": "serve_trace_warm_p50_ms",
         "value": round_keep(p50, 3),
         "unit": "ms",
@@ -1023,7 +1080,7 @@ def bench_serve_trace_warm(n_refs: int = 1 << 22,
         "cold_first_ms": round_keep(cold, 3),
         "residency_hits": hits,
         "refs": n_refs,
-    }), flush=True)
+    })
 
 
 def bench_import(reps: int = 3) -> None:
@@ -1043,7 +1100,7 @@ def bench_import(reps: int = 3) -> None:
     n = reps * len(specs)
     log(f"bench: frontend imported {len(specs)} polybench families x"
         f"{reps} in {dt:.2f}s ({n / dt:.1f} specs/s)")
-    print(json.dumps({
+    emit_record({
         "metric": "import_polybench_specs_per_sec",
         "value": round_keep(n / dt, 3),
         "unit": "specs/s",
@@ -1052,7 +1109,7 @@ def bench_import(reps: int = 3) -> None:
         "degradations": [],
         "spec_source": "c",
         "families": sorted(specs),
-    }), flush=True)
+    })
 
 
 def bench_predict(check_n: int = 16) -> None:
@@ -1079,7 +1136,7 @@ def bench_predict(check_n: int = 16) -> None:
     method = rep.prediction.method
     log(f"bench: static predict gemm1024 ({method}): {dt * 1e3:.0f} ms "
         f"for {rep.prediction.accesses} accesses, zero device dispatches")
-    print(json.dumps({
+    emit_record({
         "metric": "gemm1024_static_predict_ms",
         "value": round_keep(dt * 1e3, 3),
         "unit": "ms",
@@ -1089,7 +1146,7 @@ def bench_predict(check_n: int = 16) -> None:
         "spec_source": "registry",
         "derivable": rep.prediction.derivable,
         "plateau_in_bracket": rep.plateau_in_bracket,
-    }), flush=True)
+    })
 
     max_err, worst, n_checked = 0.0, None, 0
     for name in sorted(REGISTRY):
@@ -1110,7 +1167,7 @@ def bench_predict(check_n: int = 16) -> None:
     log(f"bench: predict max abs MRC error vs engine over {n_checked} "
         f"families at n={check_n}: {max_err:.2e}"
         + (f" ({worst})" if worst else ""))
-    print(json.dumps({
+    emit_record({
         "metric": "predict_max_abs_err",
         # UNROUNDED magnitudes survive (the r5 round_keep lesson): a
         # bit-identical histogram gives ~1e-16 summation-order noise here
@@ -1123,7 +1180,7 @@ def bench_predict(check_n: int = 16) -> None:
         "families_checked": n_checked,
         "n": check_n,
         "worst_family": worst,
-    }), flush=True)
+    })
 
 
 def bench_cotenancy(n: int = 16) -> None:
@@ -1144,7 +1201,7 @@ def bench_cotenancy(n: int = 16) -> None:
     dt = time.perf_counter() - t0
     log(f"bench: cotenancy gemm+syrk compose at n={n}: {dt * 1e3:.0f} ms, "
         f"{len(rep.verdicts)} verdict(s), zero device dispatches")
-    print(json.dumps({
+    emit_record({
         "metric": "cotenancy_predict_ms",
         "value": round_keep(dt * 1e3, 3),
         "unit": "ms",
@@ -1154,7 +1211,7 @@ def bench_cotenancy(n: int = 16) -> None:
         "spec_source": "registry",
         "n": n,
         "verdicts": [v.code for v in rep.verdicts],
-    }), flush=True)
+    })
 
     oracle = itf.oracle_mrcs(inputs, DEFAULT)
     max_err, worst = 0.0, None
@@ -1165,7 +1222,7 @@ def bench_cotenancy(n: int = 16) -> None:
             max_err, worst = err, w.name
     log(f"bench: cotenancy max abs composed-MRC error vs oracle at "
         f"n={n}: {max_err:.3g}" + (f" ({worst})" if worst else ""))
-    print(json.dumps({
+    emit_record({
         "metric": "cotenancy_max_abs_err",
         "value": round_keep(max_err, 9),
         "unit": "abs_mrc_error",
@@ -1175,7 +1232,7 @@ def bench_cotenancy(n: int = 16) -> None:
         "spec_source": "registry",
         "n": n,
         "worst_workload": worst,
-    }), flush=True)
+    })
 
 
 def bench_tune(n: int = 128) -> None:
@@ -1201,7 +1258,7 @@ def bench_tune(n: int = 128) -> None:
     log(f"bench: tune gemm{n} over {len(rep.candidates)} candidates: "
         f"{dt * 1e3:.0f} ms host-only ({rep.n_pruned} pruned, "
         f"{rep.n_derived} derived, verdict {rep.code})")
-    print(json.dumps({
+    emit_record({
         "metric": "tune_gemm_ms",
         "value": round_keep(dt * 1e3, 3),
         "unit": "ms",
@@ -1215,7 +1272,7 @@ def bench_tune(n: int = 128) -> None:
         "derived": rep.n_derived,
         "verdict": rep.code,
         "device_dispatches": dispatched,
-    }), flush=True)
+    })
 
 
 def bench_transform(n: int = 64) -> None:
@@ -1250,7 +1307,7 @@ def bench_transform(n: int = 64) -> None:
         f"({len(rep.entries)} transform(s), {n_legal} legal, best "
         f"{rep.best.transform.label() if rep.best else 'identity'}, "
         f"delta {rep.delta})")
-    print(json.dumps({
+    emit_record({
         "metric": "transform_search_ms",
         "value": round_keep(dt * 1e3, 3),
         "unit": "ms",
@@ -1262,9 +1319,9 @@ def bench_transform(n: int = 64) -> None:
         "transforms": len(rep.entries),
         "legal": n_legal,
         "device_dispatches": dispatched,
-    }), flush=True)
+    })
     if rep.best is not None and rep.delta is not None:
-        print(json.dumps({
+        emit_record({
             "metric": "gemm_tiled_predicted_mr_delta",
             "value": round_keep(rep.delta, 9),
             "unit": "miss_ratio_delta",
@@ -1277,7 +1334,7 @@ def bench_transform(n: int = 64) -> None:
             "best_transform": rep.best.transform.label(),
             "best_schedule": rep.best.tune.winner.candidate.label(),
             "target_kb": rep.target_kb,
-        }), flush=True)
+        })
 
 
 def bench_serve_placement(n_requests: int = 48) -> None:
@@ -1363,7 +1420,7 @@ def bench_serve_placement(n_requests: int = 48) -> None:
         log(f"bench: serve placement={knob} p50 {results[label][0]:.1f} "
             f"ms, p99 {results[label][1]:.1f} ms over {len(lat)} requests")
     on, off = results["placement"], results["advisory_only"]
-    print(json.dumps({
+    emit_record({
         "metric": "serve_placement_p99_ms",
         "value": round_keep(on[1], 3),
         "unit": "ms",
@@ -1375,7 +1432,7 @@ def bench_serve_placement(n_requests: int = 48) -> None:
         "placement_p50_ms": round_keep(on[0], 3),
         "advisory_only_p50_ms": round_keep(off[0], 3),
         "requests": n_requests,
-    }), flush=True)
+    })
 
 
 def main() -> int:
@@ -1715,14 +1772,14 @@ def main() -> int:
                 theirs = native.run(gemm(128)).mrc()
                 err = mrc_mod.l2_error(ours, theirs)
                 log(f"bench: gemm128 MRC L2 error vs native C++: {err:.2e}")
-                print(json.dumps({
+                emit_record({
                     "metric": "gemm128_mrc_l2_error_vs_native",
                     # UNROUNDED: round(err, 9) erased the 1.39e-14 in the
                     # r5 record (ADVICE r5, BENCH_r05.json value 0.0)
                     "value": err, "unit": "relative_l2",
                     "vs_baseline": None,
                     "path": engine.describe_path(gemm(128)) + "+cri+aet",
-                }), flush=True)
+                })
         except Exception as e:
             log(f"bench: mrc l2 metric failed: {e}")
 
@@ -1738,4 +1795,15 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    import signal
+
+    def _sigterm(signum, frame):
+        # a supervisor timeout must still leave a round record behind:
+        # write what was measured so far, marked rc=124
+        write_round_record(next_round_n(), 124)
+        sys.exit(124)
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    _rc = main()
+    write_round_record(next_round_n(), _rc)
+    sys.exit(_rc)

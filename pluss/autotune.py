@@ -152,6 +152,9 @@ def _read(path: str) -> dict | None:
               f"with `pluss autotune`", file=sys.stderr)
         return None
     obs.counter_add("autotune.hit")
+    obs.trace_event("autotune.consult", outcome="hit",
+                    **{k: v for k, v in doc["geometry"].items()
+                       if isinstance(v, (int, float))})
     return doc
 
 

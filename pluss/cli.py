@@ -911,6 +911,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="stats mode: validate the event stream against "
                         "the telemetry schema instead of rendering it "
                         "(exit 1 on any violation)")
+    p.add_argument("--trace", default=None, metavar="RID",
+                   help="stats mode: render the causal span tree of ONE "
+                        "request (every span/event stamped trace=RID) "
+                        "instead of the aggregate rollup")
+    p.add_argument("--follow", action="store_true",
+                   help="stats mode: live-tail a growing event stream, "
+                        "rendering records as they land (stops at the "
+                        "stream's end record or Ctrl-C)")
     p.add_argument("--telemetry", metavar="PATH", default=None,
                    help="write a structured telemetry event stream "
                         "(spans/counters/gauges as JSONL) to PATH; "
@@ -1048,6 +1056,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--prom-refresh-s", type=float, default=5.0,
                    help="serve mode: SLO gauge + prometheus textfile "
                         "(PLUSS_PROM) refresh period")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve mode: expose a live prometheus pull "
+                        "endpoint (GET /metrics) on this localhost port "
+                        "(0 = ephemeral; resolved port printed on stderr)")
+    p.add_argument("--flight-dir", default=None, metavar="DIR",
+                   help="serve mode: directory for crash flight-recorder "
+                        "dumps (flight-<id>.jsonl on watchdog abandon, "
+                        "breaker open, forced drain, or dispatch crash; "
+                        "also PLUSS_FLIGHT_DIR; default cwd)")
     p.add_argument("--warm", default=None, metavar="MODELS",
                    help="serve mode: background-precompile these models at "
                         "daemon start (comma-separated "
@@ -1174,8 +1192,11 @@ def main(argv: list[str] | None = None) -> int:
 
         if not args.target:
             p.error("stats mode requires an events.jsonl path")
+        if args.check and (args.trace or args.follow):
+            p.error("stats --check excludes --trace/--follow")
         return stats_mod.main(args.target, sys.stdout, sys.stderr,
-                              check=args.check)
+                              check=args.check, trace=args.trace,
+                              follow_stream=args.follow)
 
     from pluss import obs
 
@@ -1280,6 +1301,8 @@ def main(argv: list[str] | None = None) -> int:
             warm=args.warm,
             journal_dir=args.recover or args.journal_dir,
             drain_timeout_s=args.drain_timeout_s,
+            metrics_port=args.metrics_port,
+            flight_dir=args.flight_dir,
         )
         server = Server(socket_path=args.socket, port=args.port,
                         host=args.host, config=scfg)
@@ -1294,6 +1317,10 @@ def main(argv: list[str] | None = None) -> int:
               f"max_delay_ms={scfg.max_delay_ms:g}); SIGTERM or a "
               '{"op": "shutdown"} line drains and stops', file=sys.stderr,
               flush=True)
+        if server.metrics_port is not None:
+            print(f"pluss serve: metrics on "
+                  f"http://127.0.0.1:{server.metrics_port}/metrics",
+                  file=sys.stderr, flush=True)
         server.serve_forever()
         print("pluss serve: drained and stopped", file=sys.stderr)
         obs.flush_metrics()

@@ -195,6 +195,7 @@ def _plan_cache_get(key: str):
         return None
     if not os.path.exists(path):
         obs.counter_add("engine.plan_cache.miss")
+        obs.trace_event("plan_cache.consult", outcome="miss")
         return None
     import pickle
 
@@ -205,6 +206,7 @@ def _plan_cache_get(key: str):
         with open(path, "rb") as f:
             value = pickle.load(f)
         obs.counter_add("engine.plan_cache.hit")
+        obs.trace_event("plan_cache.consult", outcome="hit")
         try:
             os.utime(path)   # refresh LRU recency for _plan_cache_evict
         except OSError:

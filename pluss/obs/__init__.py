@@ -22,10 +22,13 @@ from pluss.obs.telemetry import (  # noqa: F401
     counter_add,
     counters,
     enabled,
+    ensure_session,
     event,
     flush_metrics,
     gauge_set,
     gauges,
+    render_prom,
     shutdown,
     span,
+    trace_event,
 )

@@ -584,6 +584,220 @@ def autotune_breakdown(counters: dict[str, float],
     return lines
 
 
+def slo_breakdown(counters: dict[str, float],
+                  gauges: dict[str, float]) -> list[str]:
+    """The SLO burn-rate block (r20): error-budget consumption over the
+    daemon's lifetime plus the live multi-window burn gauges the router
+    tier keys off.  Empty when the SLO monitor never recorded an outcome
+    (non-serve streams print nothing here)."""
+    good = counters.get("serve.slo.good", 0.0)
+    bad = counters.get("serve.slo.bad", 0.0)
+    total = good + bad
+    if not total:
+        return []
+    lines = ["serve SLO burn:"]
+    target = gauges.get("serve.slo.target")
+    frac = bad / total
+    burn = f"  (burn {frac / target:.2f}x budget)" if target else ""
+    lines.append(f"  {'outcomes good / bad':<28} "
+                 f"{int(good):>9} / {int(bad)}  ({100.0 * frac:.2f}% bad)"
+                 + burn)
+    if target is not None:
+        lines.append(f"  {'budgeted bad fraction':<28} "
+                     f"{100.0 * target:>8.2f}%")
+    bf = gauges.get("serve.slo.burn_fast")
+    bs = gauges.get("serve.slo.burn_slow")
+    if bf is not None or bs is not None:
+        lines.append(
+            f"  {'burn fast / slow (last)':<28} "
+            f"{_fmt_val(round(bf, 3)) if bf is not None else '?':>9} / "
+            f"{_fmt_val(round(bs, 3)) if bs is not None else '?'}")
+    dumps = counters.get("flight.dumps")
+    if dumps:
+        lines.append(f"  {'flight recorder dumps':<28} {int(dumps):>9}")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# per-request trace view (--trace)
+
+
+def _trace_matches(rec: dict, rid: str) -> bool:
+    """A record belongs to request ``rid`` when stamped with its trace id
+    directly, or — for the coalesced batch span — when ``rid`` appears in
+    the span's ``traces`` member list."""
+    if rec.get("trace") == rid:
+        return True
+    attrs = rec.get("attrs")
+    if isinstance(attrs, dict):
+        tr = attrs.get("traces")
+        if isinstance(tr, (list, tuple)) and rid in tr:
+            return True
+    return False
+
+
+def _attr_suffix(rec: dict) -> str:
+    attrs = rec.get("attrs")
+    parts = []
+    if rec.get("error"):
+        parts.append(f"error={rec['error']}")
+    if isinstance(attrs, dict):
+        for k in sorted(attrs):
+            v = attrs[k]
+            if isinstance(v, float):
+                v = _fmt_val(round(v, 6))
+            elif isinstance(v, (list, tuple)):
+                v = ",".join(str(x) for x in v)
+            parts.append(f"{k}={v}")
+    return ("  [" + " ".join(parts) + "]") if parts else ""
+
+
+def render_trace(records: list[dict], rid: str, out) -> int:
+    """Render one request's causal span tree: every span/event stamped
+    with ``rid``, nested by parent where the parent is also part of the
+    trace (cross-thread stages whose parent span belongs to another
+    thread's bookkeeping list at the root, ordered by start time)."""
+    spans = [r for r in records
+             if r.get("ev") == "span" and _trace_matches(r, rid)
+             and isinstance(r.get("id"), int)]
+    events = [r for r in records
+              if r.get("ev") == "event" and _trace_matches(r, rid)]
+    if not spans and not events:
+        out.write(f"trace {rid}: no records in stream\n")
+        return 1
+    sel = {r["id"] for r in spans}
+    kids: dict[int, list[dict]] = {}
+    roots: list[dict] = []
+    for r in spans:
+        p = r.get("parent")
+        (kids.setdefault(p, []) if p in sel else roots).append(r)
+    ev_kids: dict[int, list[dict]] = {}
+    loose: list[dict] = []
+    for e in events:
+        p = e.get("parent")
+        (ev_kids.setdefault(p, []) if p in sel else loose).append(e)
+    out.write(f"trace {rid}: {len(spans)} span(s), {len(events)} "
+              f"event(s)\n")
+    out.write(f"  {'stage':<44} {'start':>10} {'dur':>10}\n")
+
+    def emit(rec: dict, depth: int) -> None:
+        label = "  " + ". " * depth + str(rec.get("name", "?"))
+        t = float(rec.get("t", 0.0))
+        dur = float(rec.get("dur", 0.0))
+        out.write(f"{label:<46} {t:>9.4f}s {dur:>9.4f}s"
+                  f"{_attr_suffix(rec)}\n")
+        branch = [(float(c.get("t", 0.0)), 0, "span", c)
+                  for c in kids.get(rec["id"], [])]
+        branch += [(float(e.get("t", 0.0)), 1, "event", e)
+                   for e in ev_kids.get(rec["id"], [])]
+        for _, _, kind, item in sorted(branch, key=lambda x: (x[0], x[1])):
+            if kind == "span":
+                emit(item, depth + 1)
+            else:
+                emit_event(item, depth + 1)
+
+    def emit_event(rec: dict, depth: int) -> None:
+        label = "  " + ". " * depth + "* " + str(rec.get("name", "?"))
+        t = float(rec.get("t", 0.0))
+        out.write(f"{label:<46} {t:>9.4f}s {'-':>10}"
+                  f"{_attr_suffix(rec)}\n")
+
+    items = [(float(r.get("t", 0.0)), 0, "span", r) for r in roots]
+    items += [(float(e.get("t", 0.0)), 1, "event", e) for e in loose]
+    for _, _, kind, item in sorted(items, key=lambda x: (x[0], x[1])):
+        if kind == "span":
+            emit(item, 0)
+        else:
+            emit_event(item, 0)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# live tail (--follow)
+
+
+def _follow_line(rec: dict) -> str | None:
+    """One-line live rendering of a record; None skips it (meta noise)."""
+    ev = rec.get("ev")
+    tr = f"  trace={rec['trace']}" if rec.get("trace") else ""
+    t = rec.get("t")
+    ts = f"{float(t):>9.3f}s " if _is_num(t) else " " * 11
+    if ev == "span":
+        return (f"{ts}span  {rec.get('name', '?'):<36} "
+                f"{float(rec.get('dur', 0.0)):>9.4f}s{tr}"
+                f"{_attr_suffix(rec)}")
+    if ev == "event":
+        return (f"{ts}event {rec.get('name', '?'):<36} {'':>10}{tr}"
+                f"{_attr_suffix(rec)}")
+    if ev in ("counter", "gauge"):
+        return (f"{ts}{ev:<5} {rec.get('name', '?'):<36} "
+                f"{_fmt_val(rec.get('value', 0)):>10}")
+    if ev == "end":
+        return f"{ts}end   (stream closed, {rec.get('dur', '?')}s wall)"
+    return None
+
+
+def follow(path: str, out, err, poll_s: float = 0.25,
+           max_idle_s: float | None = None) -> int:
+    """Tail a growing telemetry stream, one line per record, until the
+    ``end`` record lands (daemon shut down) or the reader is interrupted.
+    Only COMPLETE lines render — a partially-flushed record waits for its
+    newline, mirroring the sink's torn-line discipline."""
+    import time as _time
+
+    buf = b""
+    pos = 0
+    idle = 0.0
+    appeared = False
+    try:
+        while True:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(pos)
+                    chunk = f.read()
+                appeared = True
+            except FileNotFoundError:
+                # tailing a stream the daemon hasn't created yet is the
+                # normal startup race — wait it out inside the idle budget
+                idle += poll_s
+                if max_idle_s is not None and idle >= max_idle_s:
+                    if appeared:
+                        return 0
+                    err.write(f"pluss stats: follow: no such stream "
+                              f"{path}\n")
+                    return 2
+                _time.sleep(poll_s)
+                continue
+            if chunk:
+                idle = 0.0
+                pos += len(chunk)
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if not isinstance(rec, dict):
+                        continue
+                    rendered = _follow_line(rec)
+                    if rendered is not None:
+                        out.write(rendered + "\n")
+                        out.flush()
+                    if rec.get("ev") == "end":
+                        return 0
+            else:
+                idle += poll_s
+                if max_idle_s is not None and idle >= max_idle_s:
+                    return 0
+                _time.sleep(poll_s)
+    except KeyboardInterrupt:
+        return 0
+    except OSError as e:
+        err.write(f"pluss stats: follow: {e}\n")
+        return 2
+
+
 def render(records: list[dict], out) -> None:
     """Write the human report for one loaded stream."""
     n_spans = sum(1 for r in records if r.get("ev") == "span")
@@ -645,15 +859,22 @@ def render(records: list[dict], out) -> None:
     ablock = autotune_breakdown(counters, gauges)
     if ablock:
         out.write("\n".join(ablock) + "\n")
+    slblock = slo_breakdown(counters, gauges)
+    if slblock:
+        out.write("\n".join(slblock) + "\n")
 
 
-def main(path: str, out, err, check: bool = False) -> int:
-    """Entry point behind ``pluss stats <events.jsonl> [--check]``."""
+def main(path: str, out, err, check: bool = False,
+         trace: str | None = None, follow_stream: bool = False) -> int:
+    """Entry point behind ``pluss stats <events.jsonl> [--check]
+    [--trace RID] [--follow]``."""
     import os
 
     if not os.path.exists(path):
         err.write(f"pluss stats: no such file: {path}\n")
         return 2
+    if follow_stream:
+        return follow(path, out, err)
     records, problems, notes = load(path)
     for n in notes:
         err.write(f"pluss stats: note: {n}\n")
@@ -670,5 +891,7 @@ def main(path: str, out, err, check: bool = False) -> int:
     if problems:
         for p in problems:
             err.write(f"pluss stats: {path}: {p}\n")
+    if trace is not None:
+        return render_trace(records, trace, out)
     render(records, out)
     return 0

@@ -40,6 +40,8 @@ import sys
 import threading
 import time
 
+from pluss.obs import tracectx
+
 #: event-stream schema version, stamped on the meta line; ``pluss stats
 #: --check`` refuses streams from a NEWER schema than it understands
 SCHEMA_VERSION = 1
@@ -69,7 +71,8 @@ NOOP_SPAN = _NoopSpan()
 
 
 class _Span:
-    __slots__ = ("_tel", "name", "attrs", "_start", "_id", "_parent")
+    __slots__ = ("_tel", "name", "attrs", "_start", "_id", "_parent",
+                 "_trace")
 
     def __init__(self, tel: "Telemetry", name: str, attrs: dict):
         self._tel = tel
@@ -82,6 +85,10 @@ class _Span:
         self._parent = stack[-1] if stack else None
         self._id = tel._new_id()
         stack.append(self._id)
+        # the trace stamp names the request context the work STARTED
+        # under (a batch dispatch re-binding per member still attributes
+        # the enclosing span to the lead request it entered with)
+        self._trace = tracectx.current()
         self._start = time.monotonic()
         return self
 
@@ -105,6 +112,8 @@ class _Span:
         }
         if self._parent is not None:
             rec["parent"] = self._parent
+        if self._trace is not None:
+            rec["trace"] = self._trace
         if self.attrs:
             rec["attrs"] = self.attrs
         if etype is not None:
@@ -124,7 +133,7 @@ class Telemetry:
     line-only crash contract), and span nesting state is per-thread.
     """
 
-    def __init__(self, path: str, prom_path: str | None = None):
+    def __init__(self, path: str | None, prom_path: str | None = None):
         self.path = path
         self.prom_path = prom_path
         self._lock = threading.Lock()
@@ -134,11 +143,19 @@ class Telemetry:
         self._id = 0
         self._t0 = time.monotonic()
         self._closed = False
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
-        # one run = one stream: truncate, then append-only for the run's
-        # lifetime (pluss stats reads a single run's tree)
-        self._f = open(path, "w")
+        self._taps: tuple = ()
+        if path is None:
+            # memory-only session: no sink file — records exist only for
+            # taps (the serve flight recorder's post-mortem ring) and the
+            # in-memory counter/gauge maps.  Bounded by construction: the
+            # maps are keyed aggregates and taps own their retention.
+            self._f = None
+        else:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            # one run = one stream: truncate, then append-only for the
+            # run's lifetime (pluss stats reads a single run's tree)
+            self._f = open(path, "w")
         self._emit({"ev": "meta", "schema": SCHEMA_VERSION,
                     "pid": os.getpid(), "argv": sys.argv[:8],
                     "t_wall": round(time.time(), 3), "clock": "monotonic"})
@@ -157,6 +174,13 @@ class Telemetry:
             return self._id
 
     def _emit(self, rec: dict) -> None:
+        for tap in self._taps:
+            try:
+                tap(rec)
+            except Exception:
+                pass   # a broken tap must never sink the observed run
+        if self._f is None:
+            return
         line = json.dumps(rec, separators=(",", ":")) + "\n"
         with self._lock:
             if self._closed:
@@ -177,6 +201,18 @@ class Telemetry:
                 print(f"telemetry: sink write to {self.path} failed "
                       f"({e}); disabling the event stream",
                       file=sys.stderr)
+
+    def add_tap(self, fn) -> None:
+        """Register ``fn(record_dict)`` to observe every emitted record
+        (the flight recorder's feed).  Taps run outside the sink lock on
+        the emitting thread and must be fast and non-raising; exceptions
+        are swallowed.  The tuple swap keeps iteration lock-free."""
+        with self._lock:
+            self._taps = (*self._taps, fn)
+
+    def remove_tap(self, fn) -> None:
+        with self._lock:
+            self._taps = tuple(t for t in self._taps if t is not fn)
 
     @staticmethod
     def _num(name: str, value) -> float:
@@ -205,6 +241,9 @@ class Telemetry:
                "t": round(time.monotonic() - self._t0, 6)}
         if stack:
             rec["parent"] = stack[-1]
+        tr = tracectx.current()
+        if tr is not None:
+            rec["trace"] = tr
         if attrs:
             rec["attrs"] = attrs
         self._emit(rec)
@@ -232,24 +271,19 @@ class Telemetry:
 
     def write_prom(self, path: str | None = None) -> str:
         """Prometheus-textfile-collector export of the current counters and
-        gauges (atomic tmp + replace).  Returns the path written."""
+        gauges (atomic tmp + replace).  Returns the path written.  The
+        text itself comes from :func:`render_prom` — the SAME renderer the
+        serve daemon's live ``/metrics`` endpoint serves, so a scrape and
+        the textfile can never drift in format."""
         path = path or self.prom_path
         if not path:
             raise ValueError("no prometheus textfile path configured")
-        lines = []
-        for name, v in sorted(self.counters().items()):
-            pn = _prom_name(name)
-            lines.append(f"# TYPE {pn} counter")
-            lines.append(f"{pn} {_prom_value(v)}")
-        for name, v in sorted(self.gauges().items()):
-            pn = _prom_name(name)
-            lines.append(f"# TYPE {pn} gauge")
-            lines.append(f"{pn} {_prom_value(v)}")
+        text = render_prom(self.counters(), self.gauges())
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            f.write("\n".join(lines) + ("\n" if lines else ""))
+            f.write(text)
         os.replace(tmp, path)
         return path
 
@@ -261,12 +295,13 @@ class Telemetry:
                     "dur": round(time.monotonic() - self._t0, 6)})
         with self._lock:
             self._closed = True
-            try:
-                self._f.flush()
-                os.fsync(self._f.fileno())
-            except OSError:
-                pass
-            self._f.close()
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                except OSError:
+                    pass
+                self._f.close()
         if self.prom_path:
             try:
                 self.write_prom()
@@ -332,6 +367,43 @@ def _prom_name(name: str) -> str:
 
 def _prom_value(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def render_prom(counters: dict[str, float], gauges: dict[str, float],
+                quantiles: dict[str, dict[str, float]] | None = None
+                ) -> str:
+    """The one Prometheus text renderer (exposition format 0.0.4): used
+    by the shutdown textfile export AND the serve daemon's live
+    ``/metrics`` endpoint, so the two surfaces cannot drift.  Counters
+    render as ``counter``, gauges as ``gauge``, and ``quantiles`` (name
+    -> {"0.5": v, ...}, e.g. a latency reservoir) as ``summary`` series
+    with a ``quantile`` label.  Names are sanitized by :func:`_prom_name`
+    (prefix ``pluss_``, every non-alphanumeric byte -> ``_``), and every
+    family carries ``# HELP``/``# TYPE`` header lines."""
+    lines: list[str] = []
+
+    def family(name: str, kind: str, help_text: str) -> str:
+        pn = _prom_name(name)
+        lines.append(f"# HELP {pn} {help_text}")
+        lines.append(f"# TYPE {pn} {kind}")
+        return pn
+
+    for name, v in sorted(counters.items()):
+        pn = family(name, "counter",
+                    f"pluss cumulative counter {name}")
+        lines.append(f"{pn} {_prom_value(v)}")
+    for name, v in sorted(gauges.items()):
+        pn = family(name, "gauge", f"pluss gauge {name}")
+        lines.append(f"{pn} {_prom_value(v)}")
+    for name, qs in sorted((quantiles or {}).items()):
+        pn = family(name, "summary",
+                    f"pluss latency reservoir {name}")
+        for q, v in sorted(qs.items(), key=lambda kv: float(kv[0])):
+            if v is None:
+                continue
+            lines.append(f'{pn}{{quantile="{float(q)}"}} '
+                         f"{_prom_value(v)}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 # ---------------------------------------------------------------------------
@@ -466,3 +538,36 @@ def flush_metrics() -> None:
     t = _active if _bootstrapped else active()
     if t is not None:
         t.flush_metrics()
+
+
+def trace_event(name: str, **attrs) -> None:
+    """An event emitted ONLY when a request trace context is bound.
+
+    The attribution hook for cache layers (plan cache, residency,
+    autotune): inside a serve request the hit/miss lands in the stream
+    stamped ``trace=<rid>``; outside one (engine tests, bench, CLI runs)
+    nothing is emitted, so existing streams and golden outputs are
+    byte-identical to before.  Order of checks matters: the telemetry
+    None-check comes first, keeping the disabled path free of any
+    context lookup."""
+    t = _active if _bootstrapped else active()
+    if t is not None and tracectx.current() is not None:
+        t.event(name, **attrs)
+
+
+def ensure_session() -> Telemetry:
+    """The active session, creating a MEMORY-ONLY one (no sink file) if
+    telemetry is disabled.  The serve daemon calls this so its flight
+    recorder can ring-buffer records for post-mortems even when the
+    operator never armed ``--telemetry`` — the memory session writes no
+    bytes anywhere until a dump is triggered."""
+    global _active, _bootstrapped, _atexit_registered
+    t = active()
+    if t is not None:
+        return t
+    _bootstrapped = True
+    _active = Telemetry(None)
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(shutdown)
+    return _active

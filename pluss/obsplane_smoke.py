@@ -1,0 +1,176 @@
+"""Observability-plane smoke (run.sh tier-1 gate, r20).
+
+Proves, in seconds on the CPU backend, that the serve observability
+plane behaves on every PR:
+
+1. a daemon started with a live metrics endpoint (``metrics_port=0``)
+   serves prometheus text on ``GET /metrics`` — ``# TYPE``/``# HELP``
+   hygiene, serve counters present — and the ``{"op": "metrics"}``
+   protocol verb returns the same rendering;
+2. the scraped counter values agree with the final in-process counter
+   rollup (the pull plane is the same truth, not a parallel one);
+3. ``{"op": "health"}`` carries the SLO burn-rate gauges;
+4. an injected hung dispatch (``hang@1`` at ``serve.dispatch``, watchdog
+   timeout shorter than the hang) is ABANDONED by the watchdog, the
+   request answered typed ``Overloaded``, and the crash flight recorder
+   dumps the telemetry ring to ``flight-<rid>.jsonl`` — which passes
+   ``pluss stats --check``;
+5. after shutdown the main event stream passes ``pluss stats --check``
+   and ``pluss stats --trace <rid>`` resolves the traced request to its
+   causal span tree: admission verdict -> admit -> queue wait ->
+   coalesced dispatch -> demux, with the plan-cache attribution riding
+   along.
+
+Run directly (``python -m pluss.obsplane_smoke``) or through the pytest
+wrapper in tests/test_tracectx.py.  The smoke owns its telemetry session
+(a temp-dir events.jsonl) so the stream it checks is complete and its
+counters start from zero.  Pins the CPU backend unless
+``PLUSS_SMOKE_TPU=1`` — a tier-1 gate must not hang on a tunneled
+accelerator.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+_SPEC = {"model": "gemm", "n": 16, "threads": 2, "chunk": 2,
+         "output": "both"}
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+        assert resp.status == 200, f"/metrics status {resp.status}"
+        ctype = resp.headers.get("Content-Type", "")
+        assert ctype.startswith("text/plain"), f"bad content type {ctype}"
+        return resp.read().decode("utf-8")
+
+
+def _prom_value(text: str, name: str) -> float:
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    raise AssertionError(f"{name} not in /metrics:\n{text}")
+
+
+def main() -> int:
+    from pluss import obs
+    from pluss.obs import stats as stats_mod
+    from pluss.obs import telemetry
+    from pluss.resilience import faults
+    from pluss.serve.protocol import Client
+    from pluss.serve.server import ServeConfig, Server
+
+    with tempfile.TemporaryDirectory() as td:
+        events = os.path.join(td, "events.jsonl")
+        obs.configure(events)
+
+        srv = Server(socket_path=os.path.join(td, "s.sock"),
+                     config=ServeConfig(journal_dir=td,
+                                        metrics_port=0,
+                                        flight_dir=td,
+                                        dispatch_timeout_s=1.0))
+        srv.start()
+        assert srv.metrics_port, "metrics endpoint did not come up"
+        try:
+            with Client(srv.address) as cl:
+                # -- traced request + live metrics plane ------------------
+                r = cl.request(dict(_SPEC, id="r-spec-1"))
+                assert r["ok"], f"clean spec request failed: {r}"
+
+                text = _scrape(srv.metrics_port)
+                for needle in ("# TYPE pluss_serve_requests_spec counter",
+                               "# HELP pluss_serve_requests_spec",
+                               "pluss_serve_ok"):
+                    assert needle in text, \
+                        f"/metrics missing {needle!r}:\n{text}"
+                verb = cl.request({"op": "metrics"})
+                assert verb["ok"] and "pluss_serve_ok" in verb["text"], \
+                    f"metrics verb broken: {str(verb)[:200]}"
+
+                h = cl.request({"op": "health"})
+                assert "slo_burn_fast" in h and "slo_burn_slow" in h, \
+                    f"health lacks SLO burn gauges: {h}"
+
+                # -- forced watchdog abandon -> flight dump ---------------
+                os.environ["PLUSS_FAULT_HANG_S"] = "8.0"
+                faults.install(faults.FaultPlan.parse("hang@1"))
+                try:
+                    hung = cl.request(dict(_SPEC, id="r-hang-1"))
+                finally:
+                    faults.install(None)
+                    os.environ.pop("PLUSS_FAULT_HANG_S", None)
+                assert not hung["ok"] \
+                    and hung["error"]["type"] == "Overloaded" \
+                    and "watchdog" in hung["error"]["message"], \
+                    f"hung dispatch not abandoned typed: {hung}"
+                dump = os.path.join(td, "flight-r-hang-1.jsonl")
+                for _ in range(100):
+                    if os.path.exists(dump):
+                        break
+                    time.sleep(0.05)
+                assert os.path.exists(dump), \
+                    f"watchdog abandon left no flight dump in {td}"
+                rc = stats_mod.main(dump, io.StringIO(), sys.stderr,
+                                    check=True)
+                assert rc == 0, "flight dump failed `pluss stats --check`"
+                with open(dump, encoding="utf-8") as f:
+                    meta = json.loads(f.readline())
+                assert meta.get("flight_reason") == "watchdog_abandon" \
+                    and meta.get("flight_trace") == "r-hang-1", \
+                    f"flight meta not stamped: {meta}"
+
+                # -- one more good request so the loop respawn is proven --
+                r2 = cl.request(dict(_SPEC, id="r-spec-2"))
+                assert r2["ok"], f"post-abandon request failed: {r2}"
+
+                # -- pull plane == in-process truth -----------------------
+                text = _scrape(srv.metrics_port)
+                counters = obs.counters()
+                for key, prom in (("serve.ok", "pluss_serve_ok"),
+                                  ("serve.requests.spec",
+                                   "pluss_serve_requests_spec")):
+                    got = _prom_value(text, prom)
+                    want = counters.get(key, 0.0)
+                    assert got == want, \
+                        f"{prom}={got} disagrees with {key}={want}"
+        finally:
+            srv.shutdown(drain_timeout_s=30)
+
+        telemetry.shutdown()   # closes the stream (end record)
+
+        out = io.StringIO()
+        rc = stats_mod.main(events, out, sys.stderr, check=True)
+        assert rc == 0, "main stream failed `pluss stats --check`"
+
+        out = io.StringIO()
+        rc = stats_mod.main(events, out, sys.stderr, trace="r-spec-1")
+        tree = out.getvalue()
+        assert rc == 0, f"stats --trace r-spec-1 failed:\n{tree}"
+        for needle in ("trace r-spec-1:", "admission.verdict",
+                       "serve.admit", "serve.queue_wait", "serve.batch",
+                       "serve.demux"):
+            assert needle in tree, \
+                f"span tree missing {needle!r}:\n{tree}"
+
+    print("obsplane smoke OK: /metrics scrape == op:metrics == counter "
+          "rollup, health carries SLO burn, watchdog abandon wrote a "
+          "flight dump that passes stats --check, and stats --trace "
+          "resolved the request to admission->admit->queue->batch->demux",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    if not os.environ.get("PLUSS_SMOKE_TPU") \
+            and not os.environ.get("JAX_PLATFORMS"):
+        from pluss.utils.platform import force_cpu
+
+        force_cpu()
+    sys.exit(main())

@@ -215,6 +215,7 @@ def aot_load(path: str | None):
                             action="recompiling")
         return None
     obs.counter_add("engine.plan_cache.aot_hit")
+    obs.trace_event("plan_cache.aot_consult", outcome="hit")
     try:
         os.utime(path)   # refresh the GROUP's LRU recency
     except OSError:

@@ -151,12 +151,15 @@ class ResidencyStore:
                 ent = None
             if ent is None:
                 obs.counter_add("residency.miss")
+                obs.trace_event("residency.consult", outcome="miss")
                 return None
             ent.pins += 1
             self._tick += 1
             ent.tick = self._tick
             obs.counter_add("residency.hit")
             obs.counter_add("residency.pin")
+            obs.trace_event("residency.consult", outcome="hit",
+                            nbytes=int(ent.nbytes))
             return ent
 
     def unpin(self, key: Hashable) -> None:

@@ -31,6 +31,7 @@ from __future__ import annotations
 import time
 
 from pluss import obs
+from pluss.obs import tracectx
 from pluss.serve.admission import AdmissionQueue
 from pluss.serve.protocol import Request
 
@@ -104,3 +105,11 @@ class Batcher:
         obs.counter_add("serve.batches")
         obs.counter_add("serve.batched_requests", len(batch))
         obs.gauge_set("serve.batch_occupancy", float(len(batch)))
+        if len(batch) > 1:
+            # trace-linked coalesce evidence, stamped under the lead:
+            # which rids shared this dispatch and who led it (the batch
+            # span's `traces` attr carries the same list; this event is
+            # the batcher-side half of the story)
+            with tracectx.bind(batch[0].id):
+                obs.trace_event("serve.coalesced", size=len(batch),
+                                traces=[r.id for r in batch])

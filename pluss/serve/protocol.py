@@ -48,6 +48,7 @@ import socket
 import threading
 import time
 
+from pluss import obs
 from pluss.config import SHARE_CAP, SamplerConfig
 from pluss.resilience.errors import InvalidRequest, PlussError
 from pluss.spec import LoopNestSpec, SpecContractError, loop_size
@@ -395,6 +396,11 @@ def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
             batch = trace_mod.WINDOWS_PER_BATCH * win
             req.hbm_bytes = -(-max(refs, 1) // batch) * batch * 3
         req.trace, req.fmt = path, fmt
+        # the trace path's admission gate is the size/format pricing
+        # above — record the verdict like the spec lint gate does, so a
+        # traced replay's causal tree starts at admission either way
+        obs.trace_event("admission.verdict", trace=os.path.basename(path),
+                        verdict="admit", errors=0)
         return req
     # spec request: registry model, inline spec, or frontend-derived
     # source, then the analyzer gate
@@ -439,6 +445,12 @@ def parse_request(obj, default_deadline_ms: float | None = None) -> Request:
     errs = _lint_verdict(spec)
     if not errs and obj.get("verify"):
         errs = _analyze_verdict(spec, cfg)
+    # attribution only inside a bound serve request (the connection
+    # handler binds the rid before parsing); CLI and test callers of
+    # parse_request emit nothing
+    obs.trace_event("admission.verdict", spec=spec.name,
+                    verdict="reject" if errs else "admit",
+                    errors=len(errs))
     if errs:
         raise InvalidRequest(
             f"request {rid!r}: spec {spec.name!r} rejected by the static "

@@ -71,6 +71,9 @@ import threading
 import time
 
 from pluss import obs
+from pluss.obs import tracectx
+from pluss.obs.flight import FlightRecorder
+from pluss.obs.slo import SloMonitor
 from pluss.resilience.breaker import CircuitBreaker
 from pluss.resilience.errors import (
     CompileError,
@@ -155,6 +158,17 @@ class ServeConfig:
     #: HARD drain bound (``--drain-timeout-s``): past it, still-pending
     #: requests are answered typed retryable and shutdown completes
     drain_timeout_s: float = 60.0
+    # -- observability (r20):
+    #: live metrics plane (``--metrics-port``): serve the Prometheus
+    #: rendering at ``http://127.0.0.1:<port>/metrics`` from a stdlib
+    #: HTTP thread (0 = pick a free port, resolved onto
+    #: ``Server.metrics_port``); None disables the endpoint — the
+    #: ``{"op": "metrics"}`` verb and PLUSS_PROM textfile remain
+    metrics_port: int | None = None
+    #: flight-recorder dump directory (``--flight-dir`` /
+    #: ``PLUSS_FLIGHT_DIR``, default "."): incident dumps land here as
+    #: ``flight-<rid-or-ts>.jsonl``
+    flight_dir: str | None = None
 
 
 #: ``--warm`` entry defaults (small enough to compile fast, large enough
@@ -260,6 +274,16 @@ class Server:
                                self.config.max_delay_ms,
                                placer=self._placer)
         self.latency = obs.LatencyReservoir()
+        # observability plane (r20): SLO burn monitor over request
+        # outcomes, crash flight recorder (armed in start(); creates a
+        # memory-only telemetry session when none is configured), and
+        # the optional HTTP metrics endpoint
+        self.slo = SloMonitor()
+        self.flight = FlightRecorder(out_dir=c.flight_dir)
+        self.metrics_port: int | None = None
+        self._metrics_httpd = None
+        self._owns_obs_session = False
+        self._breaker_was_open = False
         self._listener: socket.socket | None = None
         self._threads: list[threading.Thread] = []
         self._conns: list[socket.socket] = []
@@ -303,6 +327,13 @@ class Server:
 
     def start(self) -> None:
         """Bind, start the accept loop, device loop, and SLO publisher."""
+        # arm the flight recorder FIRST: its ring must hold the daemon's
+        # whole story, serve.start included.  When telemetry was not
+        # configured this bootstraps a memory-only session (torn down
+        # again in shutdown(), so embedded servers leave the process's
+        # global obs state as they found it).
+        self._owns_obs_session = not obs.enabled()
+        self.flight.arm()
         if self.socket_path is not None:
             try:
                 os.unlink(self.socket_path)
@@ -323,6 +354,8 @@ class Server:
                   max_batch=self.config.max_batch,
                   max_delay_ms=self.config.max_delay_ms,
                   placement=self._placer is not None)
+        if self.config.metrics_port is not None:
+            self._start_metrics_httpd(self.config.metrics_port)
         for name, target in (("pluss-serve-accept", self._accept_loop),
                              ("pluss-serve-slo", self._slo_loop)):
             t = threading.Thread(target=target, name=name, daemon=True)
@@ -357,6 +390,57 @@ class Server:
             self._threads.append(t)
         else:
             self._warm_done.set()   # nothing to warm: born ready
+
+    def _render_metrics(self) -> str:
+        """The live metrics text: the SAME renderer as the PLUSS_PROM
+        textfile (:func:`pluss.obs.telemetry.render_prom`), plus the
+        latency reservoir's quantiles as a Prometheus summary — a
+        scraper and the shutdown textfile can never disagree on
+        spelling."""
+        from pluss.obs.telemetry import render_prom
+
+        q = {"0.5": self.latency.quantile(0.5),
+             "0.9": self.latency.quantile(0.9),
+             "0.99": self.latency.quantile(0.99)}
+        return render_prom(obs.counters(), obs.gauges(),
+                           {"serve.latency_ms": q})
+
+    def _start_metrics_httpd(self, port: int) -> None:
+        """The pull half of the metrics plane: a stdlib HTTP server on
+        its own thread answering ``GET /metrics`` with the live
+        Prometheus rendering.  Loopback-only by design — the daemon
+        serves local callers; a fleet scraper rides the node agent."""
+        import http.server
+
+        outer = self
+
+        class _MetricsHandler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — stdlib handler API
+                if self.path.split("?")[0].rstrip("/") not in ("",
+                                                               "/metrics"):
+                    self.send_error(404)
+                    return
+                body = outer._render_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):   # scrapes are not accesslog
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                _MetricsHandler)
+        httpd.daemon_threads = True
+        self._metrics_httpd = httpd
+        self.metrics_port = httpd.server_address[1]   # resolve port 0
+        t = threading.Thread(target=httpd.serve_forever,
+                             name="pluss-serve-metrics", daemon=True)
+        t.start()
+        self._threads.append(t)
+        obs.event("serve.metrics_endpoint", port=self.metrics_port)
 
     def _warm_loop(self) -> None:
         """Background warmup: precompile each ``--warm`` entry's plan
@@ -525,9 +609,24 @@ class Server:
             self._force_drain()
         if self._hb_stop is not None:
             self._hb_stop()
+        if self._metrics_httpd is not None:
+            try:
+                self._metrics_httpd.shutdown()
+            except Exception:  # noqa: BLE001 — endpoint teardown is best-effort
+                pass
         self._publish_slo(force=True)
         obs.event("serve.stop", responses=self._responses)
         obs.flush_metrics()
+        # release the flight recorder's tap, and when the session was a
+        # memory-only bootstrap of OUR making (no --telemetry, no env),
+        # tear it down too: an embedded server must not leave a global
+        # telemetry session accumulating counters across its process
+        flight_tel = self.flight._tel
+        self.flight.disarm()
+        from pluss.obs import telemetry as _telemetry
+
+        if self._owns_obs_session and _telemetry.active() is flight_tel:
+            _telemetry.shutdown()
         with self._conn_lock:
             conns = list(self._conns)
         for c in conns:
@@ -553,6 +652,7 @@ class Server:
         claims first answers, the other goes silent."""
         obs.counter_add("serve.drain_forced")
         obs.event("serve.drain_forced", queue_depth=len(self.queue))
+        self.flight.dump("drain_forced")
         err = Overloaded(
             "server shut down before this request was served; retry",
             site="serve.drain", retry_after_ms=1000)
@@ -671,25 +771,36 @@ class Server:
             self._handle_control(op, obj, reply)
             return
         obs.counter_add("serve.requests")
-        try:
-            req = parse_request(obj, self.config.default_deadline_ms)
-        except Exception as e:  # noqa: BLE001 — typed response, no escape
-            obs.counter_add("serve.admission_rejects")
-            rid = obj.get("id") if isinstance(obj, dict) else None
-            self._respond_err(reply, rid if rid is None else str(rid),
-                              classify(e, site="serve.parse"))
-            return
+        # bind the request's trace context for the whole admission leg:
+        # the analyzer verdict inside parse_request, the journal append,
+        # and the submit/shed outcome all land stamped trace=<rid>
+        rid = obj.get("id") if isinstance(obj, dict) else None
+        with tracectx.bind(None if rid is None else str(rid)):
+            try:
+                req = parse_request(obj, self.config.default_deadline_ms)
+            except Exception as e:  # noqa: BLE001 — typed response, no escape
+                obs.counter_add("serve.admission_rejects")
+                obs.trace_event("serve.reject", error=type(e).__name__)
+                self._respond_err(reply, rid if rid is None else str(rid),
+                                  classify(e, site="serve.parse"))
+                return
         # counted by ORIGIN (spec/trace/sleep/source): a source-derived
         # request executes as kind "spec", but the SLO breakdown should
         # show the ingestion surface it arrived through
         obs.counter_add(f"serve.requests.{req.origin or req.kind}")
         req.reply = reply
-        self._journal_append(req, obj)
-        try:
-            self.queue.submit(req)
-        except Exception as e:  # noqa: BLE001 — Overloaded et al, typed
-            self._respond_err(reply, req.id, classify(
-                e, site="serve.admission"), req=req)
+        # re-bind under the PARSED id: anonymous requests are assigned
+        # one in parse_request, and that is the id the client echoes
+        with tracectx.bind(req.id):
+            self._journal_append(req, obj)
+            try:
+                self.queue.submit(req)
+                obs.trace_event("serve.admit", kind=req.kind,
+                                tenant=req.tenant or "")
+            except Exception as e:  # noqa: BLE001 — Overloaded et al, typed
+                obs.trace_event("serve.shed", error=type(e).__name__)
+                self._respond_err(reply, req.id, classify(
+                    e, site="serve.admission"), req=req)
 
     def _journal_append(self, req: Request, obj: dict) -> None:
         """Journal an admitted request BEFORE it queues: the record must
@@ -727,12 +838,21 @@ class Server:
         elif op == "health":
             with self._conn_lock:
                 n_conns = len(self._conns)
+            fast, slow = self.slo.burn_rates()
             reply({"id": obj.get("id"), "ok": True, "op": "health",
                    "breaker": self.breaker.state,
                    "queue_depth": len(self.queue),
                    "conns": n_conns,
                    "warmed": self._warm_done.is_set(),
-                   "draining": self._stopping.is_set()})
+                   "draining": self._stopping.is_set(),
+                   "slo_burn_fast": round(fast, 4),
+                   "slo_burn_slow": round(slow, 4)})
+        elif op == "metrics":
+            # the push half of the metrics plane: same rendering as the
+            # HTTP endpoint, over the protocol socket — a client that can
+            # submit requests can scrape without a second port
+            reply({"id": obj.get("id"), "ok": True, "op": "metrics",
+                   "text": self._render_metrics()})
         elif op == "ready":
             reasons = self._not_ready_reasons()
             reply({"id": obj.get("id"), "ok": True, "op": "ready",
@@ -782,6 +902,10 @@ class Server:
         if depth >= highwater:
             reasons.append(
                 f"queue depth {depth} >= high-water {highwater}")
+        if self.slo.burning_fast():
+            reasons.append(
+                f"slo burning fast (burn {self.slo.burn(self.slo.fast_s):.1f}"
+                f" >= {self.slo.burn_fast:g} over {self.slo.fast_s:g}s)")
         if self._stopping.is_set():
             reasons.append("draining")
         return reasons
@@ -861,9 +985,14 @@ class Server:
     def _bg_compile(self, lead: Request, done: threading.Event) -> None:
         from pluss import engine
 
+        # the compile worker runs on its own thread: attach the lead's
+        # trace context so the engine.plan/compile spans it records
+        # resolve to the request that parked behind them
         try:
-            engine.precompile(lead.spec, lead.cfg, lead.share_cap,
-                              window_accesses=lead.window)
+            with tracectx.attach(lead.id), \
+                    obs.span("serve.compile_bg"):
+                engine.precompile(lead.spec, lead.cfg, lead.share_cap,
+                                  window_accesses=lead.window)
         except Exception:  # noqa: BLE001 — the real dispatch will surface
             # a typed per-request error through the ladder; the parked
             # batch must still execute, so a compile failure only counts
@@ -930,11 +1059,17 @@ class Server:
                 self._dev_gen += 1
         obs.counter_add("serve.watchdog.abandoned")
         obs.counter_add("serve.watchdog.abandoned_requests", len(batch))
-        obs.event("serve.watchdog_abandon", age_s=round(age, 3),
-                  batch=len(batch))
+        with tracectx.bind(batch[0].id if batch else None):
+            obs.event("serve.watchdog_abandon", age_s=round(age, 3),
+                      batch=len(batch))
+        # the post-mortem moment: the hung dispatch's whole run-up is
+        # still in the ring
+        self.flight.dump("watchdog_abandon",
+                         rid=batch[0].id if batch else None)
         # a hang is evidence against the device, same as a classified
         # dispatch failure
         self.breaker.record_failure()
+        self._note_breaker()
         err = Overloaded(
             f"dispatch abandoned by the watchdog after {age:.1f}s; retry",
             site="serve.watchdog", retry_after_ms=1000)
@@ -942,22 +1077,43 @@ class Server:
             self._respond_err(req.reply, req.id, err, req=req)
         self._spawn_device_loop()
 
+    def _note_breaker(self) -> None:
+        """Flight-dump the OPEN transition (once per open, throttled by
+        the recorder): the failures that tripped the breaker are the
+        post-mortem, and they are still in the ring right now."""
+        is_open = self.breaker.state == "open"
+        if is_open and not self._breaker_was_open:
+            self.flight.dump("breaker_open")
+        self._breaker_was_open = is_open
+
     # -- dispatch -----------------------------------------------------------
 
     def _execute(self, batch: list[Request],
                  gen: int | None = None) -> None:
         # members can expire between batching and dispatch
+        now = time.monotonic()
         live = []
         for req in batch:
             if req.expired():
                 self._respond_deadline(req)
             else:
                 live.append(req)
+                # per-member queue-wait attribution: admission instant to
+                # dispatch pop, stamped with the member's own trace id
+                with tracectx.bind(req.id):
+                    obs.trace_event(
+                        "serve.queue_wait",
+                        ms=round((now - req.t_admit) * 1e3, 3))
         if not live:
             return
         lead = live[0]
         brownout = False
-        with obs.span("serve.batch", kind=lead.kind, size=len(live)):
+        # the batch span runs under the LEAD's context and links every
+        # member by id: `pluss stats --trace <rid>` finds this one span
+        # for any member rid via its `traces` attribute
+        with tracectx.bind(lead.id), \
+                obs.span("serve.batch", kind=lead.kind, size=len(live),
+                         traces=[r.id for r in live]):
             try:
                 if lead.kind == "sleep":
                     time.sleep(lead.sleep_ms / 1e3)
@@ -1002,11 +1158,16 @@ class Server:
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     raise
                 err = classify(e, site=f"serve.{lead.kind}")
+                # an exception escaping the ladder IS the incident the
+                # flight recorder exists for: dump the ring while the
+                # records leading here are still in it
+                self.flight.dump("dispatch_error", rid=lead.id)
                 if not brownout and isinstance(
                         err, (ResourceExhausted, CompileError)):
                     # only DEVICE evidence feeds the breaker: client
                     # errors and deadlines say nothing about the device
                     self.breaker.record_failure()
+                    self._note_breaker()
                 if isinstance(err, DeadlineExceeded):
                     # a deadline blown INSIDE the ladder must land in the
                     # same SLO counter as the queue/demux expiry paths
@@ -1087,25 +1248,32 @@ class Server:
         advisory = self._interference_advisory(lead)
         k = len(batch)
         for req in batch:
-            if req.expired():
-                self._respond_deadline(req)
-                continue
-            # demux: each tenant gets an independently-owned result view,
-            # then its own CRI pass + shaping (deterministic on equal
-            # inputs, so coalesced responses stay bit-identical to solo)
-            view = res.tenant_view()
-            ri = cri.distribute(view.noshare_list(), view.share_list(),
-                                req.cfg.thread_num)
-            payload = result_payload(req, ri, req.cfg)
-            payload["model"] = req.spec.name
-            payload["refs"] = int(view.max_iteration_count)
-            if view.degradations:
-                payload["degradations"] = list(view.degradations)
-            if advisory is not None:
-                # ADDITIVE stamp: the result fields above are untouched,
-                # so coalesced responses stay bit-identical to solo runs
-                payload["interference"] = advisory
-            self._respond_ok(req, payload, k)
+            # re-bind per member: the demux span and the response land
+            # under the MEMBER's trace id, not the batch lead's
+            with tracectx.bind(req.id):
+                if req.expired():
+                    self._respond_deadline(req)
+                    continue
+                # demux: each tenant gets an independently-owned result
+                # view, then its own CRI pass + shaping (deterministic on
+                # equal inputs, so coalesced responses stay bit-identical
+                # to solo)
+                with obs.span("serve.demux"):
+                    view = res.tenant_view()
+                    ri = cri.distribute(view.noshare_list(),
+                                        view.share_list(),
+                                        req.cfg.thread_num)
+                    payload = result_payload(req, ri, req.cfg)
+                payload["model"] = req.spec.name
+                payload["refs"] = int(view.max_iteration_count)
+                if view.degradations:
+                    payload["degradations"] = list(view.degradations)
+                if advisory is not None:
+                    # ADDITIVE stamp: the result fields above are
+                    # untouched, so coalesced responses stay bit-identical
+                    # to solo runs
+                    payload["interference"] = advisory
+                self._respond_ok(req, payload, k)
 
     def _interference_advisory(self, lead: Request) -> dict | None:
         """Co-tenancy advisory for a spec dispatch (r15): when OTHER
@@ -1204,16 +1372,19 @@ class Server:
             on_success()
         k = len(batch)
         for req in batch:
-            if req.expired():
-                self._respond_deadline(req)
-                continue
-            payload = result_payload(req, rep.histogram(), req.cfg)
-            payload["trace"] = req.trace
-            payload["refs"] = int(rep.total_count)
-            payload["n_lines"] = int(rep.n_lines)
-            if rep.degradations:
-                payload["degradations"] = list(rep.degradations)
-            self._respond_ok(req, payload, k)
+            with tracectx.bind(req.id):
+                if req.expired():
+                    self._respond_deadline(req)
+                    continue
+                with obs.span("serve.demux"):
+                    payload = result_payload(req, rep.histogram(),
+                                             req.cfg)
+                payload["trace"] = req.trace
+                payload["refs"] = int(rep.total_count)
+                payload["n_lines"] = int(rep.n_lines)
+                if rep.degradations:
+                    payload["degradations"] = list(rep.degradations)
+                self._respond_ok(req, payload, k)
 
     # -- responses / SLO ----------------------------------------------------
 
@@ -1247,6 +1418,7 @@ class Server:
         # count BEFORE replying: a client that reads counters right after
         # its response (the stats op, tests) must see itself counted
         obs.counter_add("serve.ok")
+        self.slo.record(True)
         self._finish(req, ms)
         req.reply(doc)
 
@@ -1255,6 +1427,13 @@ class Server:
         if req is not None and not self._claimed(req):
             return
         obs.counter_add("serve.errors")
+        # the SLO burns on SERVICE-attributable failures only: sheds,
+        # deadlines, device exhaustion.  Client-attributable rejects
+        # (InvalidRequest et al) and parse failures (req=None) consume
+        # nobody's error budget
+        if req is not None and isinstance(
+                err, (Overloaded, DeadlineExceeded, ResourceExhausted)):
+            self.slo.record(False)
         self._finish(None, None)
         reply(error_response(rid, err))
 
@@ -1263,6 +1442,7 @@ class Server:
             return
         obs.counter_add("serve.deadline_exceeded")
         obs.counter_add("serve.errors")
+        self.slo.record(False)
         self._finish(None, None)
         req.reply(error_response(req.id, DeadlineExceeded(
             "deadline passed before the result was produced",
@@ -1281,6 +1461,9 @@ class Server:
         if p99 is not None:
             obs.gauge_set("serve.p99_ms", round(p99, 3))
         obs.gauge_set("serve.queue_depth", float(len(self.queue)))
+        fast, slow = self.slo.burn_rates()
+        obs.gauge_set("serve.slo.burn_fast", round(fast, 4))
+        obs.gauge_set("serve.slo.burn_slow", round(slow, 4))
         with self._inflight_lock:
             inf = self._inflight
         if inf is not None:

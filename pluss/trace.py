@@ -369,6 +369,12 @@ class _FeedPool:
                  encode_fn, workers: int, depth: int):
         import threading
 
+        from pluss.obs import tracectx
+
+        # serve attribution: the pool is built on the replay thread,
+        # which runs under the request's trace context — capture it here
+        # so every worker's spans/events resolve to the same request
+        self._trace_token = tracectx.capture()
         self._end = end
         self._claim_fn, self._read_fn = claim_fn, read_fn
         self._compact_fn, self._encode_fn = compact_fn, encode_fn
@@ -428,6 +434,12 @@ class _FeedPool:
     def _run(self):
         import time as _time
 
+        from pluss.obs import tracectx
+
+        with tracectx.attach(self._trace_token):
+            self._run_inner(_time)
+
+    def _run_inner(self, _time):
         while True:
             err = None
             with self._cv:
@@ -1506,6 +1518,8 @@ def replay_file(path: str, fmt: str = "u64", cls: int = 64,
                       nbytes=st_acc.nbytes, meta={"path": path,
                                                   "stage_through": True})
         obs.counter_add("residency.stage_through")
+        obs.trace_event("residency.stage_through",
+                        nbytes=int(st_acc.nbytes))
     return ReplayResult(hist_np, done, n_lines, wire=wirefmt,
                         feed_workers=workers)
 
@@ -2449,6 +2463,8 @@ def _shard_replay_file_steal(path: str, cls: int, mesh, window: int,
                       nbytes=sum(int(v[0].nbytes) for v in value),
                       meta={"path": path, "grouped": True, "devices": D})
         _obs.counter_add("residency.stage_through")
+        _obs.trace_event("residency.stage_through",
+                         nbytes=sum(int(v[0].nbytes) for v in value))
     _obs.counter_add("shard.chunks", n_chunks)
     _obs.counter_add("shard.steals", stats["steals"])
     _obs.counter_add("trace.shard_refs_replayed", n)

@@ -194,6 +194,17 @@ JAX_PLATFORMS=cpu PLUSS_TELEMETRY="$PLUSS_HARD_LOG" \
 python -m pluss.cli stats "$PLUSS_HARD_LOG" --check 1>&2
 rm -f "$PLUSS_HARD_LOG"
 
+# observability-plane smoke (tier-1, r20): a daemon with the live
+# /metrics pull endpoint — scrape must carry # TYPE/# HELP-hygienic
+# serve counters agreeing with the {"op":"metrics"} verb AND the final
+# in-process rollup; health carries the SLO burn gauges; an injected
+# hung dispatch (hang@1, 1s watchdog) is abandoned and the crash flight
+# recorder's flight-<rid>.jsonl passes `pluss stats --check`; the
+# smoke's own event stream passes --check and `pluss stats --trace`
+# resolves the traced request to its causal span tree
+# (admission -> admit -> queue wait -> batch -> demux).
+JAX_PLATFORMS=cpu python -m pluss.obsplane_smoke 1>&2
+
 # warm-start smoke (tier-1): the persistent AOT executable cache, proven
 # across PROCESS boundaries — two fresh subprocesses run the same small
 # model sharing one plan-cache dir.  The first (cold) populates the

@@ -211,6 +211,7 @@ def _chaos_sweep_kill(sd: int) -> int:
 
 def serve(n_requests: int, sd: int, chaos: bool,
           telemetry: str | None) -> int:
+    import io
     import json
     import os
     import subprocess
@@ -790,6 +791,177 @@ def serve(n_requests: int, sd: int, chaos: bool,
                 if daemonp.poll() is None:
                     daemonp.kill()
                     daemonp.wait()
+
+        # ---- observability phase (r20): tracing armed end-to-end.  A
+        # daemon with the live /metrics endpoint and a flight-recorder
+        # dir; two injected dispatch failures (threshold 2) OPEN the
+        # breaker, whose transition dumps the telemetry ring — the dump
+        # must pass `pluss stats --check`.  After the cooldown probe
+        # re-closes it, a traced request per pool shape runs; every rid
+        # must resolve via `pluss stats --trace` to its causal span tree
+        # (admission verdict -> admit -> queue wait -> batch -> demux,
+        # with the plan-cache / residency attribution riding along), the
+        # traced responses must stay bit-identical to the solo runs, and
+        # the final /metrics scrape must agree with the daemon's own
+        # counter rollup.
+        import re as _re
+        import urllib.request as _url
+
+        from pluss.obs import stats as stats_mod
+
+        sock4 = os.path.join(tmp, "serve_obs.sock")
+        tel4 = os.path.join(tmp, "serve_obs_telemetry.jsonl")
+        flid = os.path.join(tmp, "flight")
+        err4 = os.path.join(tmp, "daemon_obs.err")
+        env4 = dict(env2)
+        env4["PLUSS_FAULT_PLAN"] = "dispatch_fail@1,dispatch_fail@2"
+        env4["PLUSS_SERVE_BREAKER_THRESHOLD"] = "2"
+        env4["PLUSS_SERVE_BREAKER_COOLDOWN_S"] = "0.5"
+        daemon4 = subprocess.Popen(
+            [sys.executable, "-m", "pluss.cli", "serve", "--socket",
+             sock4, "--cpu", "--telemetry", tel4, "--metrics-port", "0",
+             "--flight-dir", flid, "--max-batch", "8", "--max-queue",
+             "32", "--max-delay-ms", "25"],
+            cwd=here, env=env4, stderr=open(err4, "w"))
+        try:
+            for _ in range(240):
+                if os.path.exists(sock4) or daemon4.poll() is not None:
+                    break
+                time.sleep(0.5)
+            if daemon4.poll() is not None or not os.path.exists(sock4):
+                print("serve soak: FAIL — obs daemon died at start; "
+                      "stderr tail:")
+                print(open(err4).read()[-2000:])
+                failures += 1
+                raise RuntimeError("obs daemon failed to start")
+            mport = None
+            for _ in range(100):
+                m = _re.search(r"metrics on http://127\.0\.0\.1:(\d+)",
+                               open(err4).read())
+                if m:
+                    mport = int(m.group(1))
+                    break
+                time.sleep(0.1)
+            if mport is None:
+                print("serve soak: FAIL — obs daemon printed no metrics "
+                      "endpoint")
+                failures += 1
+                raise RuntimeError("no metrics endpoint")
+
+            with Client(sock4) as c:
+                # trip the breaker: two serial injected dispatch failures
+                for i in range(2):
+                    r = c.request(dict(pool[0], output="both",
+                                       id=f"obs-bad-{i}"))
+                    if r.get("ok") or r.get("error", {}).get("type") \
+                            != "ResourceExhausted":
+                        print(f"serve soak: FAIL — injected obs failure "
+                              f"{i} not classified: {r}")
+                        failures += 1
+                dump_paths = []
+                for _ in range(100):   # breaker-open transition dumps
+                    try:
+                        dump_paths = sorted(
+                            os.path.join(flid, f)
+                            for f in os.listdir(flid)
+                            if f.startswith("flight-"))
+                    except OSError:
+                        dump_paths = []
+                    if dump_paths:
+                        break
+                    time.sleep(0.1)
+                if not dump_paths:
+                    print("serve soak: FAIL — breaker open left no "
+                          "flight dump in " + flid)
+                    failures += 1
+                else:
+                    rc4 = stats_mod.main(dump_paths[0], io.StringIO(),
+                                         sys.stderr, check=True)
+                    if rc4 != 0:
+                        print("serve soak: FAIL — breaker flight dump "
+                              "failed stats --check")
+                        failures += 1
+                time.sleep(0.8)   # cooldown -> half-open probe re-closes
+                obs_reqs = [dict(pool[i], output="both", id=f"obs-{i}")
+                            for i in (0, 1, 2, 4)]
+                obs_resps = {}
+                for q in obs_reqs:
+                    obs_resps[q["id"]] = c.request(q)
+                text4 = _url.urlopen(
+                    f"http://127.0.0.1:{mport}/metrics",
+                    timeout=10).read().decode()
+                st4 = c.request({"op": "stats"})
+                c.request({"op": "shutdown"})
+            rc = daemon4.wait(timeout=60)
+            if rc != 0:
+                print(f"serve soak: FAIL — obs daemon exited {rc}; "
+                      "stderr tail:")
+                print(open(err4).read()[-2000:])
+                failures += 1
+
+            for q in obs_reqs:
+                r = obs_resps[q["id"]]
+                if not r.get("ok"):
+                    print(f"serve soak: FAIL — traced {q['id']} got {r}")
+                    failures += 1
+                    continue
+                k = key_of(q)
+                if k not in solo:
+                    solo[k] = solo_payload(q)
+                if r["mrc"] != solo[k]["mrc"] \
+                        or r["histogram"] != solo[k]["histogram"]:
+                    print(f"serve soak: FAIL — traced {q['id']} diverged "
+                          f"from the solo run (degradations="
+                          f"{r.get('degradations')})")
+                    failures += 1
+
+            # /metrics pull plane == the daemon's own rollup
+            c4 = st4.get("counters", {})
+            for key, prom in (("serve.ok", "pluss_serve_ok"),
+                              ("serve.requests.spec",
+                               "pluss_serve_requests_spec")):
+                m = _re.search(rf"^{prom} (\S+)$", text4, _re.M)
+                got = float(m.group(1)) if m else None
+                if got != c4.get(key, 0.0):
+                    print(f"serve soak: FAIL — /metrics {prom}={got} "
+                          f"disagrees with rollup {key}="
+                          f"{c4.get(key)}")
+                    failures += 1
+
+            # every traced rid resolves to its causal span tree
+            if stats_mod.main(tel4, io.StringIO(), sys.stderr,
+                              check=True) != 0:
+                print("serve soak: FAIL — obs daemon stream failed "
+                      "stats --check")
+                failures += 1
+            tree_fails = 0
+            for q in obs_reqs:
+                if not obs_resps[q["id"]].get("ok"):
+                    continue
+                buf = io.StringIO()
+                rc5 = stats_mod.main(tel4, buf, sys.stderr,
+                                     trace=q["id"])
+                tree = buf.getvalue()
+                want = ["admission.verdict", "serve.admit",
+                        "serve.queue_wait", "serve.batch", "serve.demux",
+                        "residency.consult" if "trace" in q
+                        else "plan_cache.consult"]
+                missing = [w for w in want if w not in tree]
+                if rc5 != 0 or missing:
+                    tree_fails += 1
+                    print(f"serve soak: FAIL — stats --trace {q['id']} "
+                          f"missing {missing}:\n{tree}")
+            if tree_fails:
+                failures += 1
+            print(f"serve soak: obs phase -> breaker flight dump "
+                  f"checked, {len(obs_reqs)} traced rids resolved to "
+                  f"span trees, /metrics == rollup", flush=True)
+        except RuntimeError:
+            pass   # already counted as a failure above
+        finally:
+            if daemon4.poll() is None:
+                daemon4.kill()
+                daemon4.wait()
     finally:
         if daemon.poll() is None:
             daemon.kill()

@@ -16,6 +16,14 @@ import os  # noqa: E402
 # opt back in with PLUSS_PLAN_CACHE_DIR)
 os.environ.setdefault("PLUSS_NO_PLAN_CACHE", "1")
 
+# flight-recorder dumps triggered by breaker/watchdog tests must not litter
+# the checkout (the server's default --flight-dir is the cwd); tests that
+# assert on dump contents pin their own dir explicitly
+import tempfile  # noqa: E402
+
+os.environ.setdefault("PLUSS_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="pluss_test_flight_"))
+
 from pluss.utils.platform import enable_x64, force_cpu  # noqa: E402
 
 force_cpu(n_virtual_devices=8)

@@ -145,6 +145,8 @@ def test_trace_mode_batch_windows_flag(tmp_path, capsys):
     assert outs[0] == outs[1]
 
 
+@pytest.mark.slow  # tier-1 keeps test_trace_mode; the sharded replay
+# identity itself is pinned in test_trace.py
 def test_trace_mode_shard_backend(tmp_path, capsys):
     # --backends shard routes trace mode through the device-sharded replay;
     # histogram lines must equal the streamed path's (table-slot diagnostic

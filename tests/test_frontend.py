@@ -214,6 +214,8 @@ def test_polybench_sweep_covers_new_families(corpus):
     assert not set(corpus) & set(REGISTRY)
 
 
+@pytest.mark.slow  # registry-wide engine sweep; per-family engine runs
+# ride tier-1 throughout test_engine/test_solvers
 def test_polybench_sweep_engine_runnable(corpus):
     # pinned engine-runnable: every family runs end-to-end through the
     # sampler + CRI on the CPU backend

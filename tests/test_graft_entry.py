@@ -1,6 +1,7 @@
 """The driver's contract: entry() jit-compiles, dryrun_multichip(8) passes."""
 
 import jax
+import pytest
 
 import __graft_entry__ as ge
 
@@ -22,6 +23,8 @@ def test_dryrun_multichip():
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # tier-1 keeps test_dryrun_multichip (full mesh) as
+# the dry-run representative
 def test_dryrun_multichip_small_meshes():
     # smaller meshes than the initialized device count must also hold (XLA
     # reads the virtual-device-count flag once per process, so counts can

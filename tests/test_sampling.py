@@ -23,6 +23,8 @@ def test_rate_one_single_window_is_exact():
     assert est.max_iteration_count == full.max_iteration_count
 
 
+@pytest.mark.slow  # fraction accounting also pinned by the faster
+# test_context_warming_meets_error_budget path in tier-1
 def test_sampled_fraction_reports_walked_accesses():
     # rounding: at NW=8 windows, rate=0.05 still walks 1 window = 1/8 of the
     # stream; sampled_fraction must say so (code-review r2 finding).
@@ -52,6 +54,8 @@ def test_mass_scaling():
     assert abs(mass - est.max_iteration_count) / est.max_iteration_count < 0.05
 
 
+@pytest.mark.slow  # statistical convergence axis: tier-1 keeps
+# test_context_warming_meets_error_budget as its representative
 def test_error_shrinks_with_span():
     # with NO context, the censoring bias is controlled by the sample span
     # (window size): doubling the span must cut the MRC error substantially
@@ -67,6 +71,7 @@ def test_error_shrinks_with_span():
     assert errs[2] < 0.1
 
 
+@pytest.mark.slow   # error_shrinks_with_span covers the variance axis in tier-1
 def test_uniform_workload_low_variance():
     # affine workloads are statistically uniform across windows: a 1-of-8
     # window sample estimates as well as the full 8-window walk (sampling
@@ -114,6 +119,7 @@ def test_context_warming_meets_error_budget():
     assert err <= 0.01, f"MRC L2 error {err} exceeds 1%"
 
 
+@pytest.mark.slow   # context_warming_meets_error_budget covers warming in tier-1
 def test_uniform_context_cuts_censoring_bias():
     """The uniform estimator's censoring bias falls with context warm-up
     (0.34 -> ~0.055 on GEMM-128); the residual is transient/steady mixing,
@@ -128,6 +134,7 @@ def test_uniform_context_cuts_censoring_bias():
     assert warm[0][2] < cold[0][2] / 3
 
 
+@pytest.mark.slow   # sampled_fraction test covers the fresh-carry axis in tier-1
 def test_context_zero_matches_old_behavior():
     """context_windows=0 reproduces the fresh-carry estimator; warming a
     late window strictly shrinks its (censoring-inflated) cold mass."""

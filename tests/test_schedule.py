@@ -150,6 +150,8 @@ def test_cli_analyze_single_model(capsys):
     assert "footprint" in out and "0 error(s)" in out
 
 
+@pytest.mark.slow  # registry-wide analyze sweep; single-model analyze
+# CLI coverage stays in tier-1
 def test_cli_analyze_all(capsys):
     assert cli.main(["analyze", "--all"]) == 0
     out = capsys.readouterr().out

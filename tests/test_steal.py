@@ -116,13 +116,17 @@ def test_queue_dispatcher_producer_error_propagates():
 
 STEAL_FAMILIES = [
     ("gemm16", lambda: gemm(16), SamplerConfig(cls=8)),
-    ("syrk32", lambda: REGISTRY["syrk"](32), SamplerConfig()),
-    ("cholesky16", lambda: REGISTRY["cholesky"](16), SamplerConfig(cls=8)),
+    # tier-1 keeps the affine-template representative; the ultra+var and
+    # QUAD families re-run the same seed/device matrix and live in -m slow
+    pytest.param("syrk32", lambda: REGISTRY["syrk"](32), SamplerConfig(),
+                 marks=pytest.mark.slow),
+    pytest.param("cholesky16", lambda: REGISTRY["cholesky"](16),
+                 SamplerConfig(cls=8), marks=pytest.mark.slow),
 ]
 
 
 @pytest.mark.parametrize("name,build,cfg", STEAL_FAMILIES,
-                         ids=[f[0] for f in STEAL_FAMILIES])
+                         ids=["gemm16", "syrk32", "cholesky16"])
 def test_steal_permutations_bit_identical_to_engine(name, build, cfg):
     spec = build()
     want = run(spec, cfg)
@@ -134,6 +138,7 @@ def test_steal_permutations_bit_identical_to_engine(name, build, cfg):
             assert_same(want, got, f"{name} D={n_dev} seed={seed}")
 
 
+@pytest.mark.slow   # shard_static_segmented_ab covers the tier-1 shape
 def test_steal_segmented_ab_mixed_windows():
     # gemm(24) on 4 devices: template and sort branches side by side (the
     # test_parallel mixed-window shape) — both kernels, both = engine
@@ -160,6 +165,8 @@ def test_shard_static_segmented_ab():
         assert_same(want, got, f"static segmented={segmented}")
 
 
+@pytest.mark.slow  # sub-window carry rides tier-1 via
+# test_parallel.py::test_shard_subwindows_dynamic_assignment_and_resume
 def test_steal_quad_subwindows_and_resume():
     # forced sub-windows on a triangular nest: multi-window chunks carry
     # heads/tails across windows INSIDE a chunk and across chunks
@@ -272,6 +279,8 @@ def test_trace_steal_sparse_clusters_and_ragged_tail(tmp_path):
     assert a.hist.tolist() == b.hist.tolist()
 
 
+@pytest.mark.slow  # checkpoint/resume identity rides tier-1 via
+# test_trace.py::test_shard_replay_file_resume_checkpoint
 def test_trace_checkpoint_pins_static_dispatch(tmp_path, capsys):
     # checkpointing identity IS the static segment grid: an explicit
     # steal request downgrades with a notice instead of mis-checkpointing
@@ -292,6 +301,8 @@ def test_trace_checkpoint_pins_static_dispatch(tmp_path, capsys):
 # device-group sweep: parallel == serial, elastic requeue on worker death
 
 
+@pytest.mark.slow  # tier-1 keeps test_sweep_elastic_requeue_on_worker_death
+# as the device-group sweep representative
 def test_sweep_device_groups_matches_serial():
     from pluss import sweep as sweep_mod
 
@@ -393,6 +404,7 @@ def test_readme_scaleout_section_in_sync():
         assert needle in readme, f"README Scale-out out of sync: {needle}"
 
 
+@pytest.mark.slow   # run.sh executes the real gate; the wrapper re-runs it
 def test_multichip_smoke_wrapper():
     """The run.sh multichip gate, as a pytest (small sizes)."""
     from pluss import multichip_smoke

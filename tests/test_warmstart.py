@@ -46,9 +46,9 @@ def _delta(c0, name):
 
 
 @pytest.mark.parametrize("model,n", [
-    ("gemm", 16),        # template path
-    ("syrk", 12),        # interleave-overlay path
-    ("cholesky", 10),    # quad nest — the dispatch-sliced shape
+    ("gemm", 16),        # template path — tier-1 representative
+    pytest.param("syrk", 12, marks=pytest.mark.slow),    # interleave-overlay
+    pytest.param("cholesky", 10, marks=pytest.mark.slow),  # quad nest
 ])
 def test_aot_restore_bit_identical(tmp_path, monkeypatch, model, n):
     _arm(tmp_path, monkeypatch)
@@ -76,6 +76,7 @@ def test_aot_restore_bit_identical(tmp_path, monkeypatch, model, n):
         assert _mrc_of(got, cfg) == _mrc_of(ref, cfg), tag
 
 
+@pytest.mark.slow   # engine-path aot_restore covers the restore axis in tier-1
 def test_trace_replay_aot_restore_bit_identical(tmp_path, monkeypatch):
     _arm(tmp_path, monkeypatch)
     # the replay-fn memo may hold executables resolved by EARLIER tests
